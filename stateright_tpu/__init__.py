@@ -1,0 +1,44 @@
+"""stateright_tpu: TPU-native explicit-state model checking of distributed systems.
+
+A brand-new framework with the capabilities of the Rust `stateright` library
+(reference at /root/reference), re-designed TPU-first: frontier expansion runs
+as vmapped JAX kernels, the visited set is a device-resident hash over stable
+64-bit fingerprints, and property predicates evaluate over state batches.
+
+Public API mirrors the reference's compatibility surface:
+
+    from stateright_tpu import Model, Property
+    checker = MyModel().checker().threads(4).spawn_bfs().join()
+    checker.assert_properties()
+"""
+
+from .core.fingerprint import Fingerprint, fingerprint, stable_hash
+from .core.model import Expectation, FnModel, Model, Property
+from .core.path import Path
+from .core.visitor import CheckerVisitor, FnVisitor, PathRecorder, StateRecorder
+from .checker.base import Checker
+from .checker.builder import CheckerBuilder
+from .report import ReportData, ReportDiscovery, Reporter, WriteReporter
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Checker",
+    "CheckerBuilder",
+    "CheckerVisitor",
+    "Expectation",
+    "Fingerprint",
+    "FnModel",
+    "FnVisitor",
+    "Model",
+    "Path",
+    "PathRecorder",
+    "Property",
+    "ReportData",
+    "ReportDiscovery",
+    "Reporter",
+    "StateRecorder",
+    "WriteReporter",
+    "fingerprint",
+    "stable_hash",
+]

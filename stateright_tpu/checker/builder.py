@@ -1,0 +1,99 @@
+"""Checker builder: configuration + spawn entry points for every backend.
+
+Reference: ``CheckerBuilder`` at ``/root/reference/src/checker.rs:64-267``.
+New in this framework: ``spawn_tpu_bfs`` (device frontier-expansion BFS) and
+``spawn_tpu_simulation`` (vmapped random-walk lanes).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from ..core.visitor import CheckerVisitor, FnVisitor
+
+
+class CheckerBuilder:
+    def __init__(self, model):
+        self.model = model
+        self._symmetry: Optional[Callable] = None
+        self._target_state_count: Optional[int] = None
+        self._target_max_depth: Optional[int] = None
+        self._thread_count: int = 1
+        self._visitor: Optional[CheckerVisitor] = None
+
+    # -- configuration -----------------------------------------------------
+
+    def symmetry(self) -> "CheckerBuilder":
+        """Enables symmetry reduction via ``state.representative()``."""
+        return self.symmetry_fn(lambda state: state.representative())
+
+    def symmetry_fn(self, representative: Callable) -> "CheckerBuilder":
+        self._symmetry = representative
+        return self
+
+    def target_state_count(self, count: int) -> "CheckerBuilder":
+        """The checker may exceed this number, but will never generate fewer
+        states if more exist."""
+        self._target_state_count = count if count > 0 else None
+        return self
+
+    def target_max_depth(self, depth: int) -> "CheckerBuilder":
+        self._target_max_depth = depth if depth > 0 else None
+        return self
+
+    def threads(self, thread_count: int) -> "CheckerBuilder":
+        self._thread_count = thread_count
+        return self
+
+    def visitor(self, visitor) -> "CheckerBuilder":
+        """A function or CheckerVisitor run on each evaluated state's path."""
+        if not isinstance(visitor, CheckerVisitor):
+            visitor = FnVisitor(visitor)
+        self._visitor = visitor
+        return self
+
+    # -- spawns ------------------------------------------------------------
+
+    def spawn_bfs(self):
+        """Breadth-first host checker; shortest paths when single-threaded."""
+        from .bfs import BfsChecker
+
+        return BfsChecker(self)
+
+    def spawn_dfs(self):
+        """Depth-first host checker; dramatically less memory than BFS."""
+        from .dfs import DfsChecker
+
+        return DfsChecker(self)
+
+    def spawn_on_demand(self):
+        """Lazy checker that only computes states when asked (Explorer)."""
+        from .on_demand import OnDemandChecker
+
+        return OnDemandChecker(self)
+
+    def spawn_simulation(self, seed: int, chooser=None):
+        """Random-walk checking for state spaces too large to exhaust."""
+        from .simulation import SimulationChecker, UniformChooser
+
+        return SimulationChecker(self, seed, chooser or UniformChooser())
+
+    def spawn_tpu_bfs(self, **kwargs):
+        """TPU-accelerated BFS: vmapped frontier expansion + device-resident
+        fingerprint set. Requires the model to implement ``BatchableModel``
+        (or be convertible via ``stateright_tpu.models.packing``)."""
+        from .tpu import TpuBfsChecker
+
+        return TpuBfsChecker(self, **kwargs)
+
+    def spawn_tpu_simulation(self, seed: int, lanes: int = 1024, **kwargs):
+        """TPU-accelerated simulation: N vmapped random-walk lanes."""
+        from .tpu_simulation import TpuSimulationChecker
+
+        return TpuSimulationChecker(self, seed, lanes, **kwargs)
+
+    def serve(self, address):
+        """Starts the interactive Explorer web service (blocks)."""
+        from .explorer import serve
+
+        return serve(self, address)

@@ -1,0 +1,262 @@
+"""On-demand (lazy) host checker powering the Explorer.
+
+Workers block on a control channel: ``CheckFingerprint(fp)`` targets one
+pending state for expansion; ``RunToCompletion`` unblocks fully (turning the
+checker into a plain BFS). A forwarder thread fans control messages to all
+workers. Visited set stores parent pointers like BFS.
+
+Reference design: ``OnDemandChecker`` at
+``/root/reference/src/checker/on_demand.rs``.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from collections import deque
+from typing import Deque, Dict, List, Optional, Tuple
+
+from ..core.fingerprint import Fingerprint, fingerprint
+from ..core.model import Expectation
+from ..core.path import Path
+from .base import Checker
+from .bfs import reconstruct_path
+from .job_market import JobBroker
+
+BLOCK_SIZE = 1500
+
+Job = Tuple[object, Fingerprint, frozenset, int]
+
+_CHECK = "check"
+_RUN_TO_COMPLETION = "run"
+
+
+class OnDemandChecker(Checker):
+    def __init__(self, options):
+        model = options.model
+        self._model = model
+        target_state_count = options._target_state_count
+        thread_count = max(1, options._thread_count)
+        visitor = options._visitor
+        properties = model.properties()
+        property_count = len(properties)
+
+        init_states = [s for s in model.init_states() if model.within_boundary(s)]
+        self._state_count = len(init_states)
+        self._count_lock = threading.Lock()
+        self._max_depth = 0
+        self._generated: Dict[Fingerprint, Optional[Fingerprint]] = {}
+        for s in init_states:
+            self._generated.setdefault(fingerprint(s), None)
+        ebits = frozenset(
+            i
+            for i, p in enumerate(properties)
+            if p.expectation == Expectation.EVENTUALLY
+        )
+        pending: Deque[Job] = deque(
+            (s, fingerprint(s), ebits, 1) for s in init_states
+        )
+        self._discoveries: Dict[str, Fingerprint] = {}
+        self._job_broker: JobBroker[Job] = JobBroker(thread_count)
+        self._job_broker.push(pending)
+        self._worker_error: Optional[BaseException] = None
+        self._handles: List[threading.Thread] = []
+        self._control: "queue.Queue" = queue.Queue()
+        worker_controls: List["queue.Queue"] = []
+
+        def worker(t: int, control: "queue.Queue"):
+            try:
+                pending: Deque[Job] = deque()
+                targetted: Deque[Job] = deque()
+                wait_for_fingerprints = True
+                while True:
+                    if not pending:
+                        pending = self._job_broker.pop()
+                        if not pending:
+                            return
+                    if wait_for_fingerprints:
+                        # Step 0: wait for someone to ask us to do work.
+                        while True:
+                            msg = control.get()
+                            if msg is None:
+                                return  # control channel closed
+                            kind, fp = msg
+                            if kind == _RUN_TO_COMPLETION:
+                                wait_for_fingerprints = False
+                                break
+                            # _CHECK: look for the fp in our pending queue.
+                            if not pending:
+                                break
+                            index = next(
+                                (
+                                    i
+                                    for i, (_s, f, _e, _d) in enumerate(pending)
+                                    if f == fp
+                                ),
+                                None,
+                            )
+                            if index is not None:
+                                job = pending[index]
+                                del pending[index]
+                                targetted.append(job)
+                                break
+                    if not wait_for_fingerprints:
+                        targetted.extend(pending)
+                        pending.clear()
+
+                    # Step 1: do work on the targetted slice.
+                    self._check_block(targetted, pending, properties, visitor)
+                    pending.extend(targetted)
+                    targetted.clear()
+                    if len(self._discoveries) == property_count:
+                        return
+                    if (
+                        target_state_count is not None
+                        and target_state_count <= self._state_count
+                    ):
+                        return
+                    # Step 2: share work.
+                    if len(pending) > 1 and thread_count > 1:
+                        self._job_broker.split_and_push(pending)
+            except BaseException as e:  # noqa: BLE001
+                if self._worker_error is None:
+                    self._worker_error = e
+            finally:
+                self._job_broker.close()
+
+        for t in range(thread_count):
+            control: "queue.Queue" = queue.Queue()
+            worker_controls.append(control)
+            h = threading.Thread(
+                target=worker, args=(t, control), name=f"checker-{t}", daemon=True
+            )
+            h.start()
+            self._handles.append(h)
+
+        def forwarder():
+            while True:
+                msg = self._control.get()
+                for c in worker_controls:
+                    c.put(msg)
+                if msg is None:
+                    return
+
+        # The forwarder is deliberately NOT in handles: it lives as long as the
+        # control channel and is a daemon thread, so join() after
+        # run_to_completion() doesn't block on it.
+        fh = threading.Thread(target=forwarder, name="control-forwarder", daemon=True)
+        fh.start()
+        self._forwarder = fh
+
+    def _check_block(
+        self,
+        targetted: Deque[Job],
+        pending: Deque[Job],
+        properties,
+        visitor,
+    ) -> None:
+        """Expand up to BLOCK_SIZE states from ``targetted``; newly generated
+        states go back onto ``pending`` (to await the next control message)."""
+        model = self._model
+        generated = self._generated
+        discoveries = self._discoveries
+        local: List[Job] = []
+        for _ in range(min(BLOCK_SIZE, len(targetted))):
+            local.append(targetted.popleft())
+        generated_count = 0
+        block_max_depth = self._max_depth
+        try:
+            while local:
+                state, state_fp, ebits, depth = local.pop()
+                if depth > block_max_depth:
+                    block_max_depth = depth
+                if visitor is not None:
+                    visitor.visit(
+                        model, reconstruct_path(model, generated, state_fp)
+                    )
+
+                is_awaiting_discoveries = False
+                for i, prop in enumerate(properties):
+                    if prop.name in discoveries:
+                        continue
+                    if prop.expectation == Expectation.ALWAYS:
+                        if not prop.condition(model, state):
+                            discoveries[prop.name] = state_fp
+                        else:
+                            is_awaiting_discoveries = True
+                    elif prop.expectation == Expectation.SOMETIMES:
+                        if prop.condition(model, state):
+                            discoveries[prop.name] = state_fp
+                        else:
+                            is_awaiting_discoveries = True
+                    else:  # EVENTUALLY
+                        is_awaiting_discoveries = True
+                        if prop.condition(model, state):
+                            ebits = ebits - {i}
+                if not is_awaiting_discoveries:
+                    return
+
+                is_terminal = True
+                actions: List = []
+                model.actions(state, actions)
+                for action in actions:
+                    next_state = model.next_state(state, action)
+                    if next_state is None:
+                        continue
+                    if not model.within_boundary(next_state):
+                        continue
+                    generated_count += 1
+                    next_fp = fingerprint(next_state)
+                    if next_fp in generated:
+                        is_terminal = False
+                        continue
+                    generated[next_fp] = state_fp
+                    is_terminal = False
+                    pending.appendleft((next_state, next_fp, ebits, depth + 1))
+                if is_terminal:
+                    for i, prop in enumerate(properties):
+                        if i in ebits:
+                            discoveries[prop.name] = state_fp
+        finally:
+            with self._count_lock:
+                self._state_count += generated_count
+                if block_max_depth > self._max_depth:
+                    self._max_depth = block_max_depth
+
+    # -- Checker surface ---------------------------------------------------
+
+    def model(self):
+        return self._model
+
+    def check_fingerprint(self, fp: Fingerprint) -> None:
+        self._control.put((_CHECK, fp))
+
+    def run_to_completion(self) -> None:
+        self._control.put((_RUN_TO_COMPLETION, None))
+
+    def state_count(self) -> int:
+        return self._state_count
+
+    def unique_state_count(self) -> int:
+        return len(self._generated)
+
+    def max_depth(self) -> int:
+        return self._max_depth
+
+    def discoveries(self) -> Dict[str, Path]:
+        return {
+            name: reconstruct_path(self._model, self._generated, fp)
+            for name, fp in list(self._discoveries.items())
+        }
+
+    def handles(self) -> List[threading.Thread]:
+        handles, self._handles = self._handles, []
+        return handles
+
+    def is_done(self) -> bool:
+        return self._job_broker.is_closed() or len(self._discoveries) == len(
+            self._model.properties()
+        )
+
+    def worker_error(self) -> Optional[BaseException]:
+        return self._worker_error

@@ -1,0 +1,19 @@
+"""Test configuration: force a virtual 8-device CPU mesh before JAX loads.
+
+Multi-chip hardware is not available in CI; sharding tests run on
+``xla_force_host_platform_device_count=8`` per the project test strategy.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import sys
+
+sys.path.insert(0, os.path.dirname(__file__))
+sys.path.insert(0, os.path.dirname(os.path.dirname(__file__)))

@@ -1,0 +1,115 @@
+"""Tiny deterministic model fixtures — the acceptance harness for every backend.
+
+Behavioral parity with the reference fixtures at
+``/root/reference/src/test_util.rs`` (BinaryClock, DGraph, LinearEquation,
+Panicker, fn-as-model).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Set
+
+from stateright_tpu import Model, Property
+
+
+class BinaryClock(Model):
+    """A machine that cycles between two states."""
+
+    GO_LOW = "GoLow"
+    GO_HIGH = "GoHigh"
+
+    def init_states(self):
+        return [0, 1]
+
+    def actions(self, state, actions):
+        actions.append(self.GO_HIGH if state == 0 else self.GO_LOW)
+
+    def next_state(self, state, action):
+        return 1 if action == self.GO_HIGH else 0
+
+    def properties(self):
+        return [Property.always("in [0, 1]", lambda _, state: 0 <= state <= 1)]
+
+
+class DGraph(Model):
+    """A directed graph, specified via paths from initial states."""
+
+    def __init__(self, prop: Property):
+        self.inits: Set[int] = set()
+        self.edges: Dict[int, Set[int]] = {}
+        self.prop = prop
+
+    @staticmethod
+    def with_property(prop: Property) -> "DGraph":
+        return DGraph(prop)
+
+    def with_path(self, path: List[int]) -> "DGraph":
+        src = path[0]
+        self.inits.add(src)
+        for dst in path[1:]:
+            self.edges.setdefault(src, set()).add(dst)
+            src = dst
+        return self
+
+    def check(self):
+        return self.checker().spawn_bfs().join()
+
+    def init_states(self):
+        return sorted(self.inits)
+
+    def actions(self, state, actions):
+        actions.extend(sorted(self.edges.get(state, ())))
+
+    def next_state(self, state, action):
+        return action
+
+    def properties(self):
+        return [self.prop]
+
+
+class LinearEquation(Model):
+    """Finds x, y in u8 such that a*x + b*y = c (mod 256)."""
+
+    INCREASE_X = "IncreaseX"
+    INCREASE_Y = "IncreaseY"
+
+    def __init__(self, a: int, b: int, c: int):
+        self.a, self.b, self.c = a, b, c
+
+    def init_states(self):
+        return [(0, 0)]
+
+    def actions(self, state, actions):
+        actions.append(self.INCREASE_X)
+        actions.append(self.INCREASE_Y)
+
+    def next_state(self, state, action):
+        x, y = state
+        if action == self.INCREASE_X:
+            return ((x + 1) % 256, y)
+        return (x, (y + 1) % 256)
+
+    def properties(self):
+        def solvable(model, solution):
+            x, y = solution
+            return (model.a * x + model.b * y) % 256 == model.c % 256
+
+        return [Property.sometimes("solvable", solvable)]
+
+
+class Panicker(Model):
+    """A model that raises during checking (worker shutdown test)."""
+
+    def init_states(self):
+        return [0]
+
+    def actions(self, state, actions):
+        actions.append(1)
+
+    def next_state(self, last_state, action):
+        if last_state == 5:
+            raise RuntimeError("reached panic state")
+        return last_state + action
+
+    def properties(self):
+        return [Property.always("true", lambda _m, _s: True)]

@@ -1,0 +1,75 @@
+"""BFS checker tests — behavioral parity with ``src/checker/bfs.rs`` tests."""
+
+import pytest
+
+from fixtures import LinearEquation, Panicker
+from stateright_tpu import StateRecorder
+
+
+def test_visits_states_in_bfs_order():
+    recorder = StateRecorder()
+    LinearEquation(2, 10, 14).checker().visitor(recorder).spawn_bfs().join()
+    assert recorder.states == [
+        (0, 0),  # distance 0
+        (1, 0), (0, 1),  # distance 1
+        (2, 0), (1, 1), (0, 2),  # distance 2
+        (3, 0), (2, 1),  # distance 3
+    ]
+
+
+def test_can_complete_by_enumerating_all_states():
+    checker = LinearEquation(2, 4, 7).checker().spawn_bfs().join()
+    assert checker.is_done()
+    checker.assert_no_discovery("solvable")
+    assert checker.unique_state_count() == 256 * 256
+
+
+def test_can_complete_by_eliminating_properties():
+    checker = LinearEquation(2, 10, 14).checker().spawn_bfs().join()
+    checker.assert_properties()
+    assert checker.unique_state_count() == 12
+
+    # BFS found this example... (2*2 + 10*1) % 256 == 14
+    assert checker.discovery("solvable").into_actions() == [
+        "IncreaseX", "IncreaseX", "IncreaseY",
+    ]
+    # ...but there are other solutions, e.g. (2*0 + 10*27) % 256 == 14.
+    checker.assert_discovery("solvable", ["IncreaseY"] * 27)
+
+
+def test_handles_panics_gracefully():
+    # A worker raising must shut down all threads; join surfaces the failure.
+    with pytest.raises(RuntimeError):
+        Panicker().checker().threads(2).spawn_bfs().join()
+
+
+def test_multithreaded_counts_match():
+    single = LinearEquation(2, 4, 7).checker().spawn_bfs().join()
+    multi = LinearEquation(2, 4, 7).checker().threads(4).spawn_bfs().join()
+    assert multi.unique_state_count() == single.unique_state_count() == 65536
+
+
+def test_target_state_count_stops_early():
+    checker = (
+        LinearEquation(2, 4, 7)
+        .checker()
+        .target_state_count(100)
+        .spawn_bfs()
+        .join()
+    )
+    # Overshoot is allowed, undershoot is not (while states remain).
+    assert 100 <= checker.state_count() < 65536 * 2
+
+
+def test_target_max_depth_bounds_exploration():
+    checker = (
+        LinearEquation(2, 4, 7)
+        .checker()
+        .target_max_depth(3)
+        .spawn_bfs()
+        .join()
+    )
+    assert checker.max_depth() == 3
+    # depth 1 (init) + depth 2 + depth 3 enqueued; depth-3 states not expanded:
+    # states at depth d are the (x, y) with x+y == d-1, i.e. d of them.
+    assert checker.unique_state_count() == 1 + 2 + 3

@@ -1,0 +1,108 @@
+"""Core-layer tests: fingerprinting, paths, visitors.
+
+Mirrors reference coverage in ``src/lib.rs``, ``src/checker/path.rs`` tests.
+"""
+
+import dataclasses
+
+import pytest
+
+from fixtures import LinearEquation
+from stateright_tpu import FnModel, Path, fingerprint, stable_hash
+
+
+class TestFingerprint:
+    def test_stable_across_calls(self):
+        assert fingerprint((1, 2, "x")) == fingerprint((1, 2, "x"))
+
+    def test_nonzero(self):
+        for v in [0, 1, "", (), None, frozenset()]:
+            assert fingerprint(v) != 0
+
+    def test_distinguishes_values(self):
+        assert fingerprint((1, 2)) != fingerprint((2, 1))
+        assert fingerprint(1) != fingerprint("1")
+        assert fingerprint((1, (2,))) != fingerprint(((1,), 2))
+        assert fingerprint(0) != fingerprint(False)
+
+    def test_unordered_containers_are_order_insensitive(self):
+        assert stable_hash({1, 2, 3}) == stable_hash({3, 1, 2})
+        assert stable_hash({"a": 1, "b": 2}) == stable_hash({"b": 2, "a": 1})
+        assert stable_hash(frozenset([1, 2])) == stable_hash({2, 1})
+
+    def test_list_and_tuple_equivalent(self):
+        assert stable_hash([1, 2]) == stable_hash((1, 2))
+
+    def test_dataclass(self):
+        @dataclasses.dataclass
+        class S:
+            x: int
+            y: tuple
+
+        assert stable_hash(S(1, (2,))) == stable_hash(S(1, (2,)))
+        assert stable_hash(S(1, (2,))) != stable_hash(S(2, (2,)))
+
+    def test_golden_values(self):
+        # Pin fingerprints so accidental encoding changes (which would break
+        # path-by-fingerprint replay across versions) are caught.
+        assert fingerprint((0, 0)) == 10608462791517047230
+        assert fingerprint("init") == 15397491202650269466
+
+    def test_rejects_unhashable(self):
+        with pytest.raises(TypeError):
+            stable_hash(object())
+
+
+class TestPath:
+    def test_from_fingerprints_replays_model(self):
+        model = LinearEquation(2, 10, 14)
+        fps = [
+            fingerprint((0, 0)),
+            fingerprint((0, 1)),
+            fingerprint((1, 1)),
+            fingerprint((2, 1)),
+        ]
+        path = Path.from_fingerprints(model, fps)
+        assert path.last_state() == (2, 1)
+        assert path.last_state() == Path.final_state(model, fps)
+
+    def test_from_fingerprints_raises_on_bad_init(self):
+        def fn(prev, out):
+            if prev is None:
+                out.append("UNEXPECTED")
+
+        model = FnModel(fn)
+        with pytest.raises(RuntimeError, match="No\ninit state"):
+            Path.from_fingerprints(model, [fingerprint("expected")])
+
+    def test_from_fingerprints_raises_on_bad_next(self):
+        def fn(prev, out):
+            if prev is None:
+                out.append("expected")
+            else:
+                out.append("UNEXPECTED")
+
+        model = FnModel(fn)
+        with pytest.raises(RuntimeError, match="no subsequent"):
+            Path.from_fingerprints(
+                model, [fingerprint("expected"), fingerprint("expected")]
+            )
+
+    def test_from_actions(self):
+        model = LinearEquation(2, 10, 14)
+        path = Path.from_actions(model, (0, 0), ["IncreaseX", "IncreaseY"])
+        assert path.last_state() == (1, 1)
+        assert path.into_actions() == ["IncreaseX", "IncreaseY"]
+        assert Path.from_actions(model, (9, 9), ["IncreaseX"]) is None
+
+    def test_encode_and_display(self):
+        model = LinearEquation(2, 10, 14)
+        path = Path.from_actions(model, (0, 0), ["IncreaseX"])
+        assert path.encode() == f"{fingerprint((0, 0))}/{fingerprint((1, 0))}"
+        assert str(path) == "Path[1]:\n- 'IncreaseX'\n"
+
+    def test_into_states_and_vec(self):
+        model = LinearEquation(2, 10, 14)
+        path = Path.from_actions(model, (0, 0), ["IncreaseY"])
+        assert path.into_states() == [(0, 0), (0, 1)]
+        assert path.into_vec() == [((0, 0), "IncreaseY"), ((0, 1), None)]

@@ -1,0 +1,70 @@
+"""DFS checker tests — behavioral parity with ``src/checker/dfs.rs`` tests."""
+
+import pytest
+
+from fixtures import LinearEquation, Panicker
+from stateright_tpu import StateRecorder
+
+
+def test_visits_states_in_dfs_order():
+    recorder = StateRecorder()
+    LinearEquation(2, 10, 14).checker().visitor(recorder).spawn_dfs().join()
+    assert recorder.states == [(0, 0)] + [(0, y) for y in range(1, 28)]
+
+
+def test_can_complete_by_enumerating_all_states():
+    checker = LinearEquation(2, 4, 7).checker().spawn_dfs().join()
+    assert checker.is_done()
+    checker.assert_no_discovery("solvable")
+    assert checker.unique_state_count() == 256 * 256
+
+
+def test_can_complete_by_eliminating_properties():
+    checker = LinearEquation(2, 10, 14).checker().spawn_dfs().join()
+    checker.assert_properties()
+    assert checker.unique_state_count() == 55
+
+    # DFS found this example... (2*0 + 10*27) % 256 == 14
+    assert checker.discovery("solvable").into_actions() == ["IncreaseY"] * 27
+    checker.assert_discovery("solvable", ["IncreaseX", "IncreaseY", "IncreaseX"])
+
+
+def test_handles_panics_gracefully():
+    with pytest.raises(RuntimeError):
+        Panicker().checker().threads(2).spawn_dfs().join()
+
+
+def test_can_apply_symmetry_reduction():
+    # Two interchangeable counters: state (a, b); representative sorts them.
+    from stateright_tpu import Model, Property
+
+    class TwoCounters(Model):
+        def init_states(self):
+            return [(0, 0)]
+
+        def actions(self, state, actions):
+            a, b = state
+            if a < 3:
+                actions.append("IncA")
+            if b < 3:
+                actions.append("IncB")
+
+        def next_state(self, state, action):
+            a, b = state
+            return (a + 1, b) if action == "IncA" else (a, b + 1)
+
+        def properties(self):
+            return [Property.always("bounded", lambda _, s: max(s) <= 3)]
+
+    full = TwoCounters().checker().spawn_dfs().join()
+    reduced = (
+        TwoCounters()
+        .checker()
+        .symmetry_fn(lambda s: tuple(sorted(s)))
+        .spawn_dfs()
+        .join()
+    )
+    assert full.unique_state_count() == 16
+    assert reduced.unique_state_count() == 10  # multisets {a<=b} of 0..3
+    full.assert_properties()
+    reduced.assert_properties()
