@@ -1,0 +1,56 @@
+"""Shared CLI plumbing for example binaries (subcommand parsing, reporter).
+
+Mirrors the reference examples' pico_args conventions: each example exposes
+``check [N] [NETWORK]``, some ``check-sym``, ``explore [N] [ADDR]``, actor
+examples ``spawn``.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from stateright_tpu import WriteReporter  # noqa: E402
+from stateright_tpu.actor import Network  # noqa: E402
+
+
+def thread_count() -> int:
+    return os.cpu_count() or 1
+
+
+def parse_args(argv):
+    """Returns (subcommand, free_args)."""
+    args = argv[1:]
+    if not args:
+        return None, []
+    return args[0], args[1:]
+
+
+def opt_int(free, index, default):
+    try:
+        return int(free[index])
+    except (IndexError, ValueError):
+        return default
+
+
+def opt_str(free, index, default):
+    try:
+        return free[index]
+    except IndexError:
+        return default
+
+
+def opt_network(free, index, default_name="unordered_nonduplicating"):
+    name = opt_str(free, index, default_name)
+    return Network.from_name(name)
+
+
+def report(checker):
+    checker.report(WriteReporter(sys.stdout))
+    return checker
+
+
+def network_names() -> str:
+    return " | ".join(Network.names())

@@ -1,0 +1,40 @@
+#!/usr/bin/env python
+"""IncrementLock example CLI (reference: examples/increment_lock.rs)."""
+
+import sys
+
+from _cli import opt_int, opt_str, parse_args, report, thread_count
+
+from stateright_tpu.models.increment import IncrementLock
+
+
+def main(argv=sys.argv):
+    cmd, free = parse_args(argv)
+    if cmd == "check":
+        n = opt_int(free, 0, 3)
+        print(f"Model checking increment_lock with {n} threads.")
+        report(IncrementLock(n).checker().threads(thread_count()).spawn_dfs())
+    elif cmd == "check-sym":
+        n = opt_int(free, 0, 3)
+        print(f"Model checking increment_lock with {n} threads using symmetry reduction.")
+        report(
+            IncrementLock(n)
+            .checker()
+            .threads(thread_count())
+            .symmetry()
+            .spawn_dfs()
+        )
+    elif cmd == "explore":
+        n = opt_int(free, 0, 3)
+        address = opt_str(free, 1, "localhost:3000")
+        print(f"Exploring the state space of increment_lock with {n} threads on {address}.")
+        IncrementLock(n).checker().threads(thread_count()).serve(address)
+    else:
+        print("USAGE:")
+        print("  ./increment_lock.py check [THREAD_COUNT]")
+        print("  ./increment_lock.py check-sym [THREAD_COUNT]")
+        print("  ./increment_lock.py explore [THREAD_COUNT] [ADDRESS]")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,80 @@
+#!/usr/bin/env python
+"""ABD linearizable register example CLI
+(reference: examples/linearizable-register.rs)."""
+
+import sys
+
+from _cli import (
+    network_names,
+    opt_int,
+    opt_network,
+    opt_str,
+    parse_args,
+    report,
+    thread_count,
+)
+
+from stateright_tpu.models.linearizable_register import AbdActor, AbdModelCfg
+
+
+def main(argv=sys.argv):
+    cmd, free = parse_args(argv)
+    if cmd == "check":
+        client_count = opt_int(free, 0, 2)
+        network = opt_network(free, 1)
+        print(f"Model checking an ABD quorum register with {client_count} clients.")
+        report(
+            AbdModelCfg(
+                client_count=client_count, server_count=2, network=network
+            )
+            .into_model()
+            .checker()
+            .threads(thread_count())
+            .spawn_dfs()
+        )
+    elif cmd == "explore":
+        client_count = opt_int(free, 0, 2)
+        address = opt_str(free, 1, "localhost:3000")
+        network = opt_network(free, 2)
+        print(
+            f"Exploring state space for an ABD quorum register with "
+            f"{client_count} clients on {address}."
+        )
+        AbdModelCfg(
+            client_count=client_count, server_count=2, network=network
+        ).into_model().checker().threads(thread_count()).serve(address)
+    elif cmd == "spawn":
+        import json
+
+        from stateright_tpu.actor import Id
+        from stateright_tpu.actor.spawn import spawn
+        from stateright_tpu.actor.wire import (
+            register_msg_from_wire,
+            register_msg_to_wire,
+        )
+
+        port = 3000
+        print("  A set of servers implementing an ABD quorum register.")
+        print(f"$ nc -u localhost {port}")
+        print(json.dumps({"Put": [1, "X"]}))
+        print(json.dumps({"Get": [2]}))
+        print()
+        ids = [Id.from_socket_addr("127.0.0.1", port + i) for i in range(3)]
+        spawn(
+            register_msg_to_wire,
+            register_msg_from_wire,
+            [
+                (ids[i], AbdActor([ids[j] for j in range(3) if j != i]))
+                for i in range(3)
+            ],
+        )
+    else:
+        print("USAGE:")
+        print("  ./linearizable_register.py check [CLIENT_COUNT] [NETWORK]")
+        print("  ./linearizable_register.py explore [CLIENT_COUNT] [ADDRESS] [NETWORK]")
+        print("  ./linearizable_register.py spawn")
+        print(f"NETWORK: {network_names()}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,54 @@
+#!/usr/bin/env python
+"""Single-copy register example CLI
+(reference: examples/single-copy-register.rs)."""
+
+import sys
+
+from _cli import (
+    network_names,
+    opt_int,
+    opt_network,
+    opt_str,
+    parse_args,
+    report,
+    thread_count,
+)
+
+from stateright_tpu.models.single_copy_register import SingleCopyModelCfg
+
+
+def main(argv=sys.argv):
+    cmd, free = parse_args(argv)
+    if cmd == "check":
+        client_count = opt_int(free, 0, 2)
+        network = opt_network(free, 1)
+        print(f"Model checking a single-copy register with {client_count} clients.")
+        report(
+            SingleCopyModelCfg(
+                client_count=client_count, server_count=1, network=network
+            )
+            .into_model()
+            .checker()
+            .threads(thread_count())
+            .spawn_dfs()
+        )
+    elif cmd == "explore":
+        client_count = opt_int(free, 0, 2)
+        address = opt_str(free, 1, "localhost:3000")
+        network = opt_network(free, 2)
+        print(
+            f"Exploring state space for a single-copy register with "
+            f"{client_count} clients on {address}."
+        )
+        SingleCopyModelCfg(
+            client_count=client_count, server_count=1, network=network
+        ).into_model().checker().threads(thread_count()).serve(address)
+    else:
+        print("USAGE:")
+        print("  ./single_copy_register.py check [CLIENT_COUNT] [NETWORK]")
+        print("  ./single_copy_register.py explore [CLIENT_COUNT] [ADDRESS] [NETWORK]")
+        print(f"NETWORK: {network_names()}")
+
+
+if __name__ == "__main__":
+    main()

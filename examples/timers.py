@@ -1,0 +1,39 @@
+#!/usr/bin/env python
+"""Timer-driven pinger example CLI (reference: examples/timers.rs)."""
+
+import sys
+
+from _cli import network_names, opt_network, opt_str, parse_args, report, thread_count
+
+from stateright_tpu.models.timers import PingerModelCfg
+
+
+def main(argv=sys.argv):
+    cmd, free = parse_args(argv)
+    if cmd == "check":
+        network = opt_network(free, 0)
+        print("Model checking Pingers")
+        report(
+            PingerModelCfg(server_count=3, network=network)
+            .into_model()
+            .checker()
+            .threads(thread_count())
+            .target_max_depth(6)
+            .spawn_dfs()
+        )
+    elif cmd == "explore":
+        address = opt_str(free, 0, "localhost:3000")
+        network = opt_network(free, 1)
+        print(f"Exploring state space for Pingers on {address}.")
+        PingerModelCfg(server_count=3, network=network).into_model().checker().threads(
+            thread_count()
+        ).serve(address)
+    else:
+        print("USAGE:")
+        print("  ./timers.py check [NETWORK]")
+        print("  ./timers.py explore [ADDRESS] [NETWORK]")
+        print(f"NETWORK: {network_names()}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,56 @@
+#!/usr/bin/env python
+"""Two-phase commit example CLI (reference: examples/2pc.rs)."""
+
+import sys
+
+from _cli import opt_int, opt_str, parse_args, report, thread_count
+
+from stateright_tpu.models.two_phase_commit import TwoPhaseSys
+
+
+def main(argv=sys.argv):
+    cmd, free = parse_args(argv)
+    if cmd == "check":
+        rm_count = opt_int(free, 0, 2)
+        print(f"Checking two phase commit with {rm_count} resource managers.")
+        report(
+            TwoPhaseSys(rm_count)
+            .checker()
+            .threads(thread_count())
+            .spawn_dfs()
+        )
+    elif cmd == "check-sym":
+        rm_count = opt_int(free, 0, 2)
+        print(
+            f"Checking two phase commit with {rm_count} resource managers "
+            "using symmetry reduction."
+        )
+        report(
+            TwoPhaseSys(rm_count)
+            .checker()
+            .threads(thread_count())
+            .symmetry()
+            .spawn_dfs()
+        )
+    elif cmd == "check-tpu":
+        rm_count = opt_int(free, 0, 2)
+        print(f"Checking two phase commit with {rm_count} resource managers on TPU.")
+        report(TwoPhaseSys(rm_count).checker().spawn_tpu_bfs())
+    elif cmd == "explore":
+        rm_count = opt_int(free, 0, 2)
+        address = opt_str(free, 1, "localhost:3000")
+        print(
+            f"Exploring state space for two phase commit with {rm_count} "
+            f"resource managers on {address}."
+        )
+        TwoPhaseSys(rm_count).checker().serve(address)
+    else:
+        print("USAGE:")
+        print("  ./two_phase_commit.py check [RESOURCE_MANAGER_COUNT]")
+        print("  ./two_phase_commit.py check-sym [RESOURCE_MANAGER_COUNT]")
+        print("  ./two_phase_commit.py check-tpu [RESOURCE_MANAGER_COUNT]")
+        print("  ./two_phase_commit.py explore [RESOURCE_MANAGER_COUNT] [ADDRESS]")
+
+
+if __name__ == "__main__":
+    main()

@@ -180,7 +180,10 @@ class BfsChecker(Checker):
         return self._model
 
     def state_count(self) -> int:
-        return self._state_count
+        # Block-local counters flush once per check_block; clamp so the
+        # documented invariant state_count >= unique_state_count holds for
+        # mid-run polls too.
+        return max(self._state_count, len(self._generated))
 
     def unique_state_count(self) -> int:
         return len(self._generated)

@@ -235,7 +235,10 @@ class OnDemandChecker(Checker):
         self._control.put((_RUN_TO_COMPLETION, None))
 
     def state_count(self) -> int:
-        return self._state_count
+        # Block-local counters flush once per check_block; clamp so the
+        # documented invariant state_count >= unique_state_count holds for
+        # mid-run polls too.
+        return max(self._state_count, len(self._generated))
 
     def unique_state_count(self) -> int:
         return len(self._generated)

@@ -21,7 +21,7 @@ import dataclasses
 from hashlib import blake2b
 from typing import Any
 
-__all__ = ["fingerprint", "stable_hash", "Fingerprint"]
+__all__ = ["fingerprint", "stable_encode", "stable_hash", "Fingerprint"]
 
 # A fingerprint is a nonzero unsigned 64-bit int (reference: NonZeroU64).
 Fingerprint = int
@@ -118,6 +118,15 @@ def _encode(value: Any, out: bytearray) -> None:
             "Use ints/strs/bytes/tuples/lists/sets/dicts/dataclasses, or define "
             "__stable_fields__() returning the hashable field values."
         )
+
+
+def stable_encode(value: Any) -> bytes:
+    """The canonical byte encoding of ``value``. Byte-wise comparison of
+    encodings is a deterministic total order on stable-hashable values
+    (used by symmetry reduction's representative sort)."""
+    buf = bytearray()
+    _encode(value, buf)
+    return bytes(buf)
 
 
 def stable_hash(value: Any) -> int:

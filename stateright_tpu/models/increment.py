@@ -1,0 +1,129 @@
+"""Racy shared-counter models.
+
+``Increment``: N threads each read the shared counter into a local, then write
+local+1 back — the classic lost-update race; ``always "fin"`` is intentionally
+falsifiable. ``IncrementLock``: the same machine guarded by a lock; ``"fin"``
+and ``"mutex"`` hold.
+
+Reference: ``/root/reference/examples/increment.rs`` and
+``increment_lock.rs``. These are measurement configs in BASELINE.md.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Tuple
+
+from ..core.model import Model, Property
+
+# ProcState is (t, pc): thread-local value and program counter.
+
+
+@dataclass(frozen=True)
+class IncrementState:
+    i: int
+    s: Tuple[Tuple[int, int], ...]  # per-thread (t, pc)
+
+    def representative(self) -> "IncrementState":
+        return IncrementState(i=self.i, s=tuple(sorted(self.s)))
+
+
+class Increment(Model):
+    """pc 1: may Read (t <- i, pc 2); pc 2: may Write (i <- t+1, pc 3)."""
+
+    def __init__(self, thread_count: int):
+        self.thread_count = thread_count
+
+    def init_states(self):
+        return [IncrementState(i=0, s=((0, 1),) * self.thread_count)]
+
+    def actions(self, state, actions):
+        for thread_id, (_t, pc) in enumerate(state.s):
+            if pc == 1:
+                actions.append(("Read", thread_id))
+            elif pc == 2:
+                actions.append(("Write", thread_id))
+
+    def next_state(self, state, action):
+        kind, n = action
+        s = list(state.s)
+        if kind == "Read":
+            s[n] = (state.i, 2)
+            return IncrementState(i=state.i, s=tuple(s))
+        t, _pc = s[n]
+        s[n] = (t, 3)
+        return IncrementState(i=(t + 1) % 256, s=tuple(s))
+
+    def properties(self):
+        return [
+            Property.always(
+                "fin",
+                lambda _, state: sum(1 for _t, pc in state.s if pc == 3)
+                == state.i,
+            )
+        ]
+
+
+@dataclass(frozen=True)
+class IncrementLockState:
+    i: int
+    lock: bool
+    s: Tuple[Tuple[int, int], ...]
+
+    def representative(self) -> "IncrementLockState":
+        return IncrementLockState(i=self.i, lock=self.lock, s=tuple(sorted(self.s)))
+
+
+class IncrementLock(Model):
+    """Same counter machine with a lock; both properties hold."""
+
+    def __init__(self, thread_count: int):
+        self.thread_count = thread_count
+
+    def init_states(self):
+        return [
+            IncrementLockState(i=0, lock=False, s=((0, 0),) * self.thread_count)
+        ]
+
+    def actions(self, state, actions):
+        for thread_id, (_t, pc) in enumerate(state.s):
+            if pc == 0 and not state.lock:
+                actions.append(("Lock", thread_id))
+            elif pc == 1:
+                actions.append(("Read", thread_id))
+            elif pc == 2:
+                actions.append(("Write", thread_id))
+            elif pc == 3 and state.lock:
+                actions.append(("Release", thread_id))
+
+    def next_state(self, state, action):
+        kind, n = action
+        s = list(state.s)
+        t, pc = s[n]
+        if kind == "Lock":
+            s[n] = (t, 1)
+            return IncrementLockState(i=state.i, lock=True, s=tuple(s))
+        if kind == "Read":
+            s[n] = (state.i, 2)
+            return IncrementLockState(i=state.i, lock=state.lock, s=tuple(s))
+        if kind == "Write":
+            s[n] = (t, 3)
+            return IncrementLockState(
+                i=(t + 1) % 256, lock=state.lock, s=tuple(s)
+            )
+        s[n] = (t, 4)
+        return IncrementLockState(i=state.i, lock=False, s=tuple(s))
+
+    def properties(self):
+        return [
+            Property.always(
+                "fin",
+                lambda _, state: sum(1 for _t, pc in state.s if pc >= 3)
+                == state.i,
+            ),
+            Property.always(
+                "mutex",
+                lambda _, state: sum(1 for _t, pc in state.s if 1 <= pc < 4)
+                <= 1,
+            ),
+        ]

@@ -1,0 +1,221 @@
+"""ABD quorum register (Attiya, Bar-Noy, Dolev) — a replicated register that
+IS linearizable without consensus. 2 clients / 2 servers = 544 unique states.
+
+Internal protocol (tagged tuples inside ``Internal``):
+  ("Query", req_id)
+  ("AckQuery", req_id, seq, val)
+  ("Record", req_id, seq, val)
+  ("AckRecord", req_id)
+where seq = (logical_clock, actor_id).
+
+Reference: ``/root/reference/examples/linearizable-register.rs``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple
+
+from ..actor import Actor, ActorModel, Id, Network, Out, model_peers
+from ..actor.register import (
+    Get,
+    GetOk,
+    Internal,
+    Put,
+    PutOk,
+    RegisterClient,
+    record_invocations,
+    record_returns,
+)
+from ..core.model import Expectation
+from ..semantics import LinearizabilityTester, Register
+from .paxos import majority
+
+DEFAULT_VALUE = "\x00"
+
+
+@dataclass(frozen=True)
+class Phase1:
+    request_id: int
+    requester_id: Id
+    write: Optional[str]  # Some(value) for Put, None for Get
+    responses: Tuple  # sorted tuple of (actor_id, (seq, val))
+
+
+@dataclass(frozen=True)
+class Phase2:
+    request_id: int
+    requester_id: Id
+    read: Optional[str]  # Some(value) for Get, None for Put
+    acks: Tuple  # sorted tuple of actor ids
+
+
+@dataclass(frozen=True)
+class AbdState:
+    seq: Tuple[int, int]
+    val: str
+    phase: object  # None | Phase1 | Phase2
+
+
+class AbdActor(Actor):
+    def __init__(self, peers: List[Id]):
+        self.peers = peers
+
+    def on_start(self, id: Id, o: Out) -> AbdState:
+        return AbdState(seq=(0, id), val=DEFAULT_VALUE, phase=None)
+
+    def on_msg(self, id: Id, state: AbdState, src: Id, msg, o: Out):
+        if isinstance(msg, Put) and state.phase is None:
+            o.broadcast(self.peers, Internal(("Query", msg.request_id)))
+            return AbdState(
+                seq=state.seq,
+                val=state.val,
+                phase=Phase1(
+                    request_id=msg.request_id,
+                    requester_id=src,
+                    write=msg.value,
+                    responses=((id, (state.seq, state.val)),),
+                ),
+            )
+        if isinstance(msg, Get) and state.phase is None:
+            o.broadcast(self.peers, Internal(("Query", msg.request_id)))
+            return AbdState(
+                seq=state.seq,
+                val=state.val,
+                phase=Phase1(
+                    request_id=msg.request_id,
+                    requester_id=src,
+                    write=None,
+                    responses=((id, (state.seq, state.val)),),
+                ),
+            )
+        if not isinstance(msg, Internal):
+            return None
+        inner = msg.msg
+        kind = inner[0]
+
+        if kind == "Query":
+            o.send(src, Internal(("AckQuery", inner[1], state.seq, state.val)))
+            return None
+
+        if (
+            kind == "AckQuery"
+            and isinstance(state.phase, Phase1)
+            and state.phase.request_id == inner[1]
+        ):
+            _req, seq_in, val_in = inner[1], inner[2], inner[3]
+            phase = state.phase
+            responses = dict(phase.responses)
+            responses[src] = (seq_in, val_in)
+            if len(responses) == majority(len(self.peers) + 1):
+                # Quorum reached; move to phase 2. Sequencers are distinct, so
+                # max-by-seq is deterministic.
+                seq, val = max(responses.values(), key=lambda sv: sv[0])
+                read = None
+                if phase.write is not None:
+                    seq = (seq[0] + 1, id)
+                    val = phase.write
+                else:
+                    read = val
+                o.broadcast(
+                    self.peers, Internal(("Record", phase.request_id, seq, val))
+                )
+                # Self-send Record.
+                new_seq, new_val = state.seq, state.val
+                if seq > state.seq:
+                    new_seq, new_val = seq, val
+                # Self-send AckRecord.
+                return AbdState(
+                    seq=new_seq,
+                    val=new_val,
+                    phase=Phase2(
+                        request_id=phase.request_id,
+                        requester_id=phase.requester_id,
+                        read=read,
+                        acks=(id,),
+                    ),
+                )
+            return AbdState(
+                seq=state.seq,
+                val=state.val,
+                phase=Phase1(
+                    request_id=phase.request_id,
+                    requester_id=phase.requester_id,
+                    write=phase.write,
+                    responses=tuple(sorted(responses.items())),
+                ),
+            )
+
+        if kind == "Record":
+            _req, seq_in, val_in = inner[1], inner[2], inner[3]
+            o.send(src, Internal(("AckRecord", inner[1])))
+            if seq_in > state.seq:
+                return AbdState(seq=seq_in, val=val_in, phase=state.phase)
+            return None
+
+        if (
+            kind == "AckRecord"
+            and isinstance(state.phase, Phase2)
+            and state.phase.request_id == inner[1]
+            and src not in state.phase.acks
+        ):
+            phase = state.phase
+            acks = tuple(sorted(set(phase.acks) | {src}))
+            if len(acks) == majority(len(self.peers) + 1):
+                if phase.read is not None:
+                    o.send(
+                        phase.requester_id, GetOk(phase.request_id, phase.read)
+                    )
+                else:
+                    o.send(phase.requester_id, PutOk(phase.request_id))
+                return AbdState(seq=state.seq, val=state.val, phase=None)
+            return AbdState(
+                seq=state.seq,
+                val=state.val,
+                phase=Phase2(
+                    request_id=phase.request_id,
+                    requester_id=phase.requester_id,
+                    read=phase.read,
+                    acks=acks,
+                ),
+            )
+        return None
+
+
+@dataclass
+class AbdModelCfg:
+    client_count: int
+    server_count: int
+    network: Network = field(
+        default_factory=Network.new_unordered_nonduplicating
+    )
+
+    def into_model(self) -> ActorModel:
+        model = ActorModel(
+            cfg=self,
+            init_history=LinearizabilityTester(Register(DEFAULT_VALUE)),
+        )
+        for i in range(self.server_count):
+            model.actor(AbdActor(model_peers(i, self.server_count)))
+        for _ in range(self.client_count):
+            model.actor(
+                RegisterClient(put_count=1, server_count=self.server_count)
+            )
+
+        def value_chosen(_model, state):
+            for env in state.network.iter_deliverable():
+                if isinstance(env.msg, GetOk) and env.msg.value != DEFAULT_VALUE:
+                    return True
+            return False
+
+        return (
+            model.init_network(self.network)
+            .property(
+                Expectation.ALWAYS,
+                "linearizable",
+                lambda _, state: state.history.serialized_history() is not None,
+            )
+            .property(Expectation.SOMETIMES, "value chosen", value_chosen)
+            .record_msg_in(record_returns)
+            .record_msg_out(record_invocations)
+        )

@@ -1,0 +1,230 @@
+"""Single Decree Paxos, checked for linearizability against a register spec.
+
+Two clients / three servers under an unordered non-duplicating network reach
+exactly 16,668 unique states (the primary throughput benchmark config).
+
+Reference: ``/root/reference/examples/paxos.rs``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, List, Optional, Tuple
+
+from ..actor import Actor, ActorModel, Id, Network, Out, model_peers
+from ..actor.register import (
+    Get,
+    GetOk,
+    Internal,
+    Put,
+    PutOk,
+    RegisterClient,
+    record_invocations,
+    record_returns,
+)
+from ..core.model import Expectation
+from ..semantics import LinearizabilityTester, Register
+
+DEFAULT_VALUE = "\x00"  # the register's initial value (reference: char::default)
+
+
+def majority(cluster_size: int) -> int:
+    """The minimum size of a majority within a cluster."""
+    return cluster_size // 2 + 1
+
+
+# Internal protocol messages are tagged tuples:
+#   ("Prepare", ballot)
+#   ("Prepared", ballot, last_accepted)
+#   ("Accept", ballot, proposal)
+#   ("Accepted", ballot)
+#   ("Decided", ballot, proposal)
+# ballot = (round, leader_id); proposal = (request_id, requester_id, value);
+# last_accepted/accepted = None | (ballot, proposal).
+
+
+def _accepted_sort_key(accepted):
+    # None sorts below any accepted (ballot, proposal), like Rust's Option.
+    return (0,) if accepted is None else (1, accepted)
+
+
+@dataclass(frozen=True)
+class PaxosState:
+    # shared state
+    ballot: Tuple[int, int]
+    # leader state
+    proposal: Optional[Tuple]
+    prepares: Tuple  # sorted tuple of (acceptor_id, last_accepted)
+    accepts: FrozenSet[Id]
+    # acceptor state
+    accepted: Optional[Tuple]
+    is_decided: bool
+
+
+class PaxosActor(Actor):
+    def __init__(self, peer_ids: List[Id]):
+        self.peer_ids = peer_ids
+
+    def name(self) -> str:
+        return "Paxos Server"
+
+    def on_start(self, id: Id, o: Out) -> PaxosState:
+        return PaxosState(
+            ballot=(0, Id(0)),
+            proposal=None,
+            prepares=(),
+            accepts=frozenset(),
+            accepted=None,
+            is_decided=False,
+        )
+
+    def on_msg(self, id: Id, state: PaxosState, src: Id, msg, o: Out):
+        if state.is_decided:
+            if isinstance(msg, Get):
+                # Reply with the decided value (never reply "undecided": a
+                # value may have been decided elsewhere with delivery pending).
+                _b, (_req_id, _src, value) = state.accepted
+                o.send(src, GetOk(msg.request_id, value))
+            return None
+
+        if isinstance(msg, Put) and state.proposal is None:
+            ballot = (state.ballot[0] + 1, id)
+            proposal = (msg.request_id, src, msg.value)
+            # Simulate Prepare + Prepared self-sends.
+            prepares = ((id, state.accepted),)
+            o.broadcast(self.peer_ids, Internal(("Prepare", ballot)))
+            return PaxosState(
+                ballot=ballot,
+                proposal=proposal,
+                prepares=prepares,
+                accepts=frozenset(),
+                accepted=state.accepted,
+                is_decided=False,
+            )
+
+        if isinstance(msg, Internal):
+            inner = msg.msg
+            kind = inner[0]
+            if kind == "Prepare" and state.ballot < inner[1]:
+                ballot = inner[1]
+                o.send(
+                    src, Internal(("Prepared", ballot, state.accepted))
+                )
+                return PaxosState(
+                    ballot=ballot,
+                    proposal=state.proposal,
+                    prepares=state.prepares,
+                    accepts=state.accepts,
+                    accepted=state.accepted,
+                    is_decided=False,
+                )
+            if kind == "Prepared" and inner[1] == state.ballot:
+                ballot, last_accepted = inner[1], inner[2]
+                prepares_map = dict(state.prepares)
+                prepares_map[src] = last_accepted
+                prepares = tuple(sorted(prepares_map.items()))
+                proposal = state.proposal
+                accepted = state.accepted
+                accepts = state.accepts
+                if len(prepares) == majority(len(self.peer_ids) + 1):
+                    # Leadership handoff: favor the most recently accepted
+                    # proposal from the prepare quorum; else the client's.
+                    best = max(
+                        prepares_map.values(), key=_accepted_sort_key
+                    )
+                    proposal = best[1] if best is not None else state.proposal
+                    # Simulate Accept + Accepted self-sends.
+                    accepted = (ballot, proposal)
+                    accepts = frozenset([id])
+                    o.broadcast(
+                        self.peer_ids, Internal(("Accept", ballot, proposal))
+                    )
+                return PaxosState(
+                    ballot=state.ballot,
+                    proposal=proposal,
+                    prepares=prepares,
+                    accepts=accepts,
+                    accepted=accepted,
+                    is_decided=False,
+                )
+            if kind == "Accept" and state.ballot <= inner[1]:
+                ballot, proposal = inner[1], inner[2]
+                o.send(src, Internal(("Accepted", ballot)))
+                return PaxosState(
+                    ballot=ballot,
+                    proposal=state.proposal,
+                    prepares=state.prepares,
+                    accepts=state.accepts,
+                    accepted=(ballot, proposal),
+                    is_decided=False,
+                )
+            if kind == "Accepted" and inner[1] == state.ballot:
+                ballot = inner[1]
+                accepts = state.accepts | {src}
+                is_decided = state.is_decided
+                if len(accepts) == majority(len(self.peer_ids) + 1):
+                    is_decided = True
+                    proposal = state.proposal
+                    o.broadcast(
+                        self.peer_ids, Internal(("Decided", ballot, proposal))
+                    )
+                    request_id, requester_id, _ = proposal
+                    o.send(requester_id, PutOk(request_id))
+                return PaxosState(
+                    ballot=state.ballot,
+                    proposal=state.proposal,
+                    prepares=state.prepares,
+                    accepts=accepts,
+                    accepted=state.accepted,
+                    is_decided=is_decided,
+                )
+            if kind == "Decided":
+                ballot, proposal = inner[1], inner[2]
+                return PaxosState(
+                    ballot=ballot,
+                    proposal=state.proposal,
+                    prepares=state.prepares,
+                    accepts=state.accepts,
+                    accepted=(ballot, proposal),
+                    is_decided=True,
+                )
+        return None
+
+
+@dataclass
+class PaxosModelCfg:
+    client_count: int
+    server_count: int
+    network: Network = field(
+        default_factory=Network.new_unordered_nonduplicating
+    )
+
+    def into_model(self) -> ActorModel:
+        model = ActorModel(
+            cfg=self,
+            init_history=LinearizabilityTester(Register(DEFAULT_VALUE)),
+        )
+        for i in range(self.server_count):
+            model.actor(PaxosActor(model_peers(i, self.server_count)))
+        for _ in range(self.client_count):
+            model.actor(
+                RegisterClient(put_count=1, server_count=self.server_count)
+            )
+
+        def value_chosen(_model, state):
+            for env in state.network.iter_deliverable():
+                if isinstance(env.msg, GetOk) and env.msg.value != DEFAULT_VALUE:
+                    return True
+            return False
+
+        return (
+            model.init_network(self.network)
+            .property(
+                Expectation.ALWAYS,
+                "linearizable",
+                lambda _, state: state.history.serialized_history() is not None,
+            )
+            .property(Expectation.SOMETIMES, "value chosen", value_chosen)
+            .record_msg_in(record_returns)
+            .record_msg_out(record_invocations)
+        )

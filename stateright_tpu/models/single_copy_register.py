@@ -1,0 +1,79 @@
+"""Single-copy (non-replicated) register servers — linearizable with one
+server (93 states for 2 clients), NOT linearizable with two.
+
+Reference: ``/root/reference/examples/single-copy-register.rs``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ..actor import Actor, ActorModel, Id, Network, Out
+from ..actor.register import (
+    Get,
+    GetOk,
+    Put,
+    PutOk,
+    RegisterClient,
+    record_invocations,
+    record_returns,
+)
+from ..core.model import Expectation
+from ..semantics import LinearizabilityTester, Register
+
+DEFAULT_VALUE = "\x00"
+
+
+class SingleCopyActor(Actor):
+    def on_start(self, id: Id, o: Out) -> str:
+        return DEFAULT_VALUE
+
+    def on_msg(self, id: Id, state: str, src: Id, msg, o: Out):
+        if isinstance(msg, Put):
+            o.send(src, PutOk(msg.request_id))
+            return msg.value
+        if isinstance(msg, Get):
+            o.send(src, GetOk(msg.request_id, state))
+            # Writing the same state back still counts as a write in the
+            # reference (send side effect makes this a non-no-op anyway).
+            return None
+        return None
+
+
+@dataclass
+class SingleCopyModelCfg:
+    client_count: int
+    server_count: int
+    network: Network = field(
+        default_factory=Network.new_unordered_nonduplicating
+    )
+
+    def into_model(self) -> ActorModel:
+        model = ActorModel(
+            cfg=self,
+            init_history=LinearizabilityTester(Register(DEFAULT_VALUE)),
+        )
+        for _ in range(self.server_count):
+            model.actor(SingleCopyActor())
+        for _ in range(self.client_count):
+            model.actor(
+                RegisterClient(put_count=1, server_count=self.server_count)
+            )
+
+        def value_chosen(_model, state):
+            for env in state.network.iter_deliverable():
+                if isinstance(env.msg, GetOk) and env.msg.value != DEFAULT_VALUE:
+                    return True
+            return False
+
+        return (
+            model.init_network(self.network)
+            .property(
+                Expectation.ALWAYS,
+                "linearizable",
+                lambda _, state: state.history.serialized_history() is not None,
+            )
+            .property(Expectation.SOMETIMES, "value chosen", value_chosen)
+            .record_msg_in(record_returns)
+            .record_msg_out(record_invocations)
+        )

@@ -1,0 +1,132 @@
+"""Two-phase commit (subset of the Gray/Lamport "Consensus on Transaction
+Commit" TLA+ spec).
+
+State: per-RM states + transaction-manager state + prepared flags + a message
+set. Exact oracle counts: 3 RMs = 288 states, 5 RMs = 8,832, 5 RMs with
+symmetry = 665.
+
+Reference: ``/root/reference/examples/2pc.rs``. The packed TPU counterpart is
+``stateright_tpu.models.packed_two_phase_commit`` (state fits in a few u32s:
+``Message::Prepared{rm}`` bounds the message set to N+2 distinct values).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import FrozenSet, List, Tuple
+
+from ..core.model import Model, Property
+from ..utils.rewrite import RewritePlan
+
+# RM states
+WORKING, PREPARED, COMMITTED, ABORTED = "Working", "Prepared", "Committed", "Aborted"
+# TM states
+TM_INIT, TM_COMMITTED, TM_ABORTED = "Init", "Committed", "Aborted"
+# Messages: ("Prepared", rm) | ("Commit",) | ("Abort",)
+COMMIT_MSG = ("Commit",)
+ABORT_MSG = ("Abort",)
+
+
+def prepared_msg(rm: int) -> Tuple:
+    return ("Prepared", rm)
+
+
+@dataclass(frozen=True)
+class TwoPhaseState:
+    rm_state: Tuple[str, ...]
+    tm_state: str
+    tm_prepared: Tuple[bool, ...]
+    msgs: FrozenSet[Tuple]
+
+    def representative(self) -> "TwoPhaseState":
+        plan = RewritePlan.from_values_to_sort(self.rm_state)
+        return TwoPhaseState(
+            rm_state=tuple(plan.reindex(self.rm_state)),
+            tm_state=self.tm_state,
+            tm_prepared=tuple(plan.reindex(self.tm_prepared)),
+            msgs=frozenset(
+                ("Prepared", plan.mapping[m[1]]) if m[0] == "Prepared" else m
+                for m in self.msgs
+            ),
+        )
+
+
+class TwoPhaseSys(Model):
+    def __init__(self, rm_count: int):
+        self.rm_count = rm_count
+
+    def init_states(self) -> List[TwoPhaseState]:
+        return [
+            TwoPhaseState(
+                rm_state=(WORKING,) * self.rm_count,
+                tm_state=TM_INIT,
+                tm_prepared=(False,) * self.rm_count,
+                msgs=frozenset(),
+            )
+        ]
+
+    def actions(self, state: TwoPhaseState, actions: List) -> None:
+        if state.tm_state == TM_INIT and all(state.tm_prepared):
+            actions.append(("TmCommit",))
+        if state.tm_state == TM_INIT:
+            actions.append(("TmAbort",))
+        for rm in range(self.rm_count):
+            if state.tm_state == TM_INIT and prepared_msg(rm) in state.msgs:
+                actions.append(("TmRcvPrepared", rm))
+            if state.rm_state[rm] == WORKING:
+                actions.append(("RmPrepare", rm))
+                actions.append(("RmChooseToAbort", rm))
+            if COMMIT_MSG in state.msgs:
+                actions.append(("RmRcvCommitMsg", rm))
+            if ABORT_MSG in state.msgs:
+                actions.append(("RmRcvAbortMsg", rm))
+
+    def next_state(self, state: TwoPhaseState, action) -> TwoPhaseState:
+        kind = action[0]
+        rm_state = list(state.rm_state)
+        tm_prepared = list(state.tm_prepared)
+        tm_state = state.tm_state
+        msgs = state.msgs
+        if kind == "TmRcvPrepared":
+            tm_prepared[action[1]] = True
+        elif kind == "TmCommit":
+            tm_state = TM_COMMITTED
+            msgs = msgs | {COMMIT_MSG}
+        elif kind == "TmAbort":
+            tm_state = TM_ABORTED
+            msgs = msgs | {ABORT_MSG}
+        elif kind == "RmPrepare":
+            rm_state[action[1]] = PREPARED
+            msgs = msgs | {prepared_msg(action[1])}
+        elif kind == "RmChooseToAbort":
+            rm_state[action[1]] = ABORTED
+        elif kind == "RmRcvCommitMsg":
+            rm_state[action[1]] = COMMITTED
+        elif kind == "RmRcvAbortMsg":
+            rm_state[action[1]] = ABORTED
+        else:
+            raise ValueError(f"unknown action {action!r}")
+        return TwoPhaseState(
+            rm_state=tuple(rm_state),
+            tm_state=tm_state,
+            tm_prepared=tuple(tm_prepared),
+            msgs=msgs,
+        )
+
+    def properties(self) -> List[Property]:
+        return [
+            Property.sometimes(
+                "abort agreement",
+                lambda _, state: all(s == ABORTED for s in state.rm_state),
+            ),
+            Property.sometimes(
+                "commit agreement",
+                lambda _, state: all(s == COMMITTED for s in state.rm_state),
+            ),
+            Property.always(
+                "consistent",
+                lambda _, state: not (
+                    ABORTED in state.rm_state and COMMITTED in state.rm_state
+                ),
+            ),
+        ]

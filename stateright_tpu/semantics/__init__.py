@@ -1,0 +1,44 @@
+"""Consistency semantics: sequential reference objects ("specs") and testers
+that validate concurrent histories against a consistency model.
+
+Reference: ``/root/reference/src/semantics.rs`` and submodules.
+"""
+
+from .base import ConsistencyTester, SequentialSpec
+from .register import READ, Register, ReadOk, Write, WRITE_OK
+from .write_once_register import (
+    WORegister,
+    WO_READ,
+    WO_WRITE_FAIL,
+    WO_WRITE_OK,
+    WoReadOk,
+    WoWrite,
+)
+from .vec import VecSpec, Push, POP, LEN, PUSH_OK, PopOk, LenOk
+from .linearizability import LinearizabilityTester
+from .sequential_consistency import SequentialConsistencyTester
+
+__all__ = [
+    "ConsistencyTester",
+    "LinearizabilityTester",
+    "READ",
+    "ReadOk",
+    "Register",
+    "SequentialConsistencyTester",
+    "SequentialSpec",
+    "VecSpec",
+    "WORegister",
+    "WO_READ",
+    "WO_WRITE_FAIL",
+    "WO_WRITE_OK",
+    "WRITE_OK",
+    "WoReadOk",
+    "WoWrite",
+    "Write",
+    "Push",
+    "POP",
+    "LEN",
+    "PUSH_OK",
+    "PopOk",
+    "LenOk",
+]

@@ -1,0 +1,98 @@
+"""Symmetry-reduction machinery: rewrite plans and recursive Id rewriting.
+
+A ``RewritePlan`` is a permutation derived from sorting a state's per-actor
+rows; applying it recursively yields a behaviorally equivalent state — the
+canonical representative of the symmetry equivalence class.
+
+Reference: ``RewritePlan`` at ``/root/reference/src/checker/rewrite_plan.rs``
+(permutation-by-sorting at ``:81-106``, ``reindex`` at ``:110-123``) and the
+recursive ``Rewrite`` impls at ``/root/reference/src/checker/rewrite.rs``.
+
+On the TPU backend the representative computation is a vmapped argsort over
+packed per-actor state rows plus an Id gather (``stateright_tpu.ops``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, List, Sequence
+
+from ..core.fingerprint import stable_encode
+
+
+def canonical_sort_key(value) -> bytes:
+    """A deterministic total-order key for arbitrary stable-hashable values:
+    the canonical byte encoding (the reference requires ``V: Ord``; mixing
+    natural ordering with a hash fallback would be intransitive for
+    heterogeneous values, so the encoding alone is the order).
+
+    Any deterministic total order yields a valid canonicalization — the set of
+    equivalence classes (and hence symmetry-reduced state counts) does not
+    depend on which member is chosen as representative."""
+    return stable_encode(value)
+
+
+class RewritePlan:
+    """Maps old actor indices (Ids) to new ones."""
+
+    def __init__(self, mapping: List[int]):
+        # mapping[old_index] = new_index
+        self.mapping = mapping
+
+    @staticmethod
+    def from_values_to_sort(values: Sequence) -> "RewritePlan":
+        """Builds the permutation that stable-sorts ``values``."""
+        order = sorted(range(len(values)), key=lambda i: canonical_sort_key(values[i]))
+        mapping = [0] * len(values)
+        for new_index, old_index in enumerate(order):
+            mapping[old_index] = new_index
+        return RewritePlan(mapping)
+
+    def rewrite_id(self, id_value):
+        from ..actor.actor import Id
+
+        return Id(self.mapping[int(id_value)])
+
+    def reindex(self, indexed: Sequence) -> List:
+        """Permutes a per-actor vector (result[new] = rewrite(indexed[old]))
+        and recursively rewrites each element."""
+        result = [None] * len(self.mapping)
+        for old_index, new_index in enumerate(self.mapping):
+            result[new_index] = rewrite_value(indexed[old_index], self)
+        return result
+
+
+def rewrite_value(value, plan: RewritePlan):
+    """Recursively rewrites every ``Id`` inside ``value`` per ``plan``.
+
+    Only instances of ``stateright_tpu.actor.Id`` are rewritten; plain ints
+    pass through (mirroring the reference where only the ``Id`` type
+    implements ``Rewrite<Id>`` non-trivially)."""
+    from ..actor.actor import Id
+
+    if isinstance(value, Id):
+        return plan.rewrite_id(value)
+    if value is None or isinstance(value, (bool, int, float, str, bytes)):
+        return value
+    if isinstance(value, tuple):
+        return tuple(rewrite_value(v, plan) for v in value)
+    if isinstance(value, list):
+        return [rewrite_value(v, plan) for v in value]
+    if isinstance(value, (set, frozenset)):
+        return frozenset(rewrite_value(v, plan) for v in value)
+    if isinstance(value, dict):
+        return {
+            rewrite_value(k, plan): rewrite_value(v, plan)
+            for k, v in value.items()
+        }
+    if hasattr(value, "__rewrite__"):
+        return value.__rewrite__(plan)
+    if dataclasses.is_dataclass(value) and not isinstance(value, type):
+        return type(value)(
+            **{
+                f.name: rewrite_value(getattr(value, f.name), plan)
+                for f in dataclasses.fields(value)
+            }
+        )
+    # Opaque values (e.g. Timers) are returned unchanged.
+    return value

@@ -1,0 +1,208 @@
+"""Consistency-semantics tests — parity with the reference's hand-built
+history accept/reject cases (``src/semantics/*.rs`` test modules)."""
+
+import pytest
+
+from stateright_tpu.semantics import (
+    LEN,
+    LenOk,
+    LinearizabilityTester,
+    POP,
+    PUSH_OK,
+    PopOk,
+    Push,
+    READ,
+    ReadOk,
+    Register,
+    SequentialConsistencyTester,
+    VecSpec,
+    WORegister,
+    WO_READ,
+    WO_WRITE_FAIL,
+    WO_WRITE_OK,
+    WoReadOk,
+    WoWrite,
+    WRITE_OK,
+    Write,
+)
+
+
+class TestRegisterSpec:
+    def test_models_expected_semantics(self):
+        r = Register("A")
+        assert r.invoke(READ) == ReadOk("A")
+        assert r.invoke(Write("B")) == WRITE_OK
+        assert r.invoke(READ) == ReadOk("B")
+
+    def test_accepts_valid_histories(self):
+        assert Register("A").is_valid_history([])
+        assert Register("A").is_valid_history(
+            [
+                (READ, ReadOk("A")),
+                (Write("B"), WRITE_OK),
+                (READ, ReadOk("B")),
+                (Write("C"), WRITE_OK),
+                (READ, ReadOk("C")),
+            ]
+        )
+
+    def test_rejects_invalid_histories(self):
+        assert not Register("A").is_valid_history(
+            [(READ, ReadOk("B")), (Write("B"), WRITE_OK)]
+        )
+        assert not Register("A").is_valid_history(
+            [(Write("B"), WRITE_OK), (READ, ReadOk("A"))]
+        )
+
+
+class TestWORegisterSpec:
+    def test_write_once(self):
+        r = WORegister(None)
+        assert r.invoke(WoWrite("A")) == WO_WRITE_OK
+        assert r.invoke(WoWrite("A")) == WO_WRITE_OK  # same value ok
+        assert r.invoke(WoWrite("B")) == WO_WRITE_FAIL
+        assert r.invoke(WO_READ) == WoReadOk(("Some", "A"))
+
+
+class TestLinearizability:
+    def test_rejects_invalid_history(self):
+        t = LinearizabilityTester(Register("A"))
+        t.on_invoke(99, Write("B"))
+        with pytest.raises(ValueError, match="already has an operation in flight"):
+            t.on_invoke(99, Write("C"))
+        t2 = LinearizabilityTester(Register("A"))
+        t2.on_invret(99, Write("B"), WRITE_OK)
+        t2.on_invret(99, Write("C"), WRITE_OK)
+        with pytest.raises(ValueError, match="no in-flight invocation"):
+            t2.on_return(99, WRITE_OK)
+
+    def test_identifies_linearizable_register_history(self):
+        t = LinearizabilityTester(Register("A"))
+        t.on_invoke(0, Write("B"))
+        t.on_invret(1, READ, ReadOk("A"))
+        assert t.serialized_history() == [(READ, ReadOk("A"))]
+
+        t = LinearizabilityTester(Register("A"))
+        t.on_invoke(0, READ)
+        t.on_invoke(1, Write("B"))
+        t.on_return(0, ReadOk("B"))
+        assert t.serialized_history() == [
+            (Write("B"), WRITE_OK),
+            (READ, ReadOk("B")),
+        ]
+
+    def test_identifies_unlinearizable_register_history(self):
+        t = LinearizabilityTester(Register("A"))
+        t.on_invret(0, READ, ReadOk("B"))
+        assert t.serialized_history() is None
+
+        t = LinearizabilityTester(Register("A"))
+        t.on_invret(0, READ, ReadOk("B"))
+        t.on_invoke(1, Write("B"))
+        assert t.serialized_history() is None  # SC but not linearizable
+
+    def test_identifies_linearizable_vec_history(self):
+        t = LinearizabilityTester(VecSpec())
+        t.on_invoke(0, Push(10))
+        assert t.serialized_history() == []
+
+        t = LinearizabilityTester(VecSpec())
+        t.on_invoke(0, Push(10))
+        t.on_invret(1, POP, PopOk(None))
+        assert t.serialized_history() == [(POP, PopOk(None))]
+
+        t = LinearizabilityTester(VecSpec())
+        t.on_invoke(0, Push(10))
+        t.on_invret(1, POP, PopOk(("Some", 10)))
+        assert t.serialized_history() == [
+            (Push(10), PUSH_OK),
+            (POP, PopOk(("Some", 10))),
+        ]
+
+        t = LinearizabilityTester(VecSpec())
+        t.on_invret(0, Push(10), PUSH_OK)
+        t.on_invoke(0, Push(20))
+        t.on_invret(1, LEN, LenOk(1))
+        t.on_invret(1, POP, PopOk(("Some", 20)))
+        t.on_invret(1, POP, PopOk(("Some", 10)))
+        assert t.serialized_history() == [
+            (Push(10), PUSH_OK),
+            (LEN, LenOk(1)),
+            (Push(20), PUSH_OK),
+            (POP, PopOk(("Some", 20))),
+            (POP, PopOk(("Some", 10))),
+        ]
+
+        t = LinearizabilityTester(VecSpec())
+        t.on_invret(0, Push(10), PUSH_OK)
+        t.on_invoke(1, LEN)
+        t.on_invoke(0, Push(20))
+        t.on_return(1, LenOk(2))
+        assert t.serialized_history() == [
+            (Push(10), PUSH_OK),
+            (Push(20), PUSH_OK),
+            (LEN, LenOk(2)),
+        ]
+
+    def test_identifies_unlinearizable_vec_history(self):
+        t = LinearizabilityTester(VecSpec())
+        t.on_invret(0, Push(10), PUSH_OK)
+        t.on_invret(1, POP, PopOk(None))
+        assert t.serialized_history() is None  # SC but not linearizable
+
+        t = LinearizabilityTester(VecSpec())
+        t.on_invret(0, Push(10), PUSH_OK)
+        t.on_invoke(1, LEN)
+        t.on_invoke(0, Push(20))
+        t.on_return(1, LenOk(0))
+        assert t.serialized_history() is None
+
+        t = LinearizabilityTester(VecSpec())
+        t.on_invret(0, Push(10), PUSH_OK)
+        t.on_invoke(0, Push(20))
+        t.on_invret(1, LEN, LenOk(2))
+        t.on_invret(1, POP, PopOk(("Some", 10)))
+        t.on_invret(1, POP, PopOk(("Some", 20)))
+        assert t.serialized_history() is None
+
+
+class TestSequentialConsistency:
+    def test_accepts_stale_read_disallowed_by_linearizability(self):
+        # Thread 1's read may be ordered before thread 0's completed write.
+        t = SequentialConsistencyTester(Register("A"))
+        t.on_invret(0, Write("B"), WRITE_OK)
+        t.on_invret(1, READ, ReadOk("A"))
+        assert t.serialized_history() == [
+            (READ, ReadOk("A")),
+            (Write("B"), WRITE_OK),
+        ]
+        lt = LinearizabilityTester(Register("A"))
+        lt.on_invret(0, Write("B"), WRITE_OK)
+        lt.on_invret(1, READ, ReadOk("A"))
+        assert lt.serialized_history() is None
+
+    def test_respects_program_order(self):
+        t = SequentialConsistencyTester(Register("A"))
+        t.on_invret(0, Write("B"), WRITE_OK)
+        t.on_invret(0, READ, ReadOk("A"))  # own stale read: invalid under SC
+        assert t.serialized_history() is None
+
+    def test_is_consistent(self):
+        t = SequentialConsistencyTester(Register("A"))
+        assert t.is_consistent()
+        t.on_invret(0, READ, ReadOk("A"))
+        assert t.is_consistent()
+
+
+class TestTesterValueSemantics:
+    def test_clone_and_hash(self):
+        from stateright_tpu import stable_hash
+
+        t = LinearizabilityTester(Register("A"))
+        t.on_invoke(0, Write("B"))
+        c = t.clone()
+        assert t == c
+        assert stable_hash(t) == stable_hash(c)
+        c.on_return(0, WRITE_OK)
+        assert t != c
+        assert stable_hash(t) != stable_hash(c)
