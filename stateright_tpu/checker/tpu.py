@@ -1,0 +1,459 @@
+"""TPU breadth-first checker: frontier waves expanded as fused device kernels.
+
+This is the TPU-native re-architecture of the reference's ``BfsChecker``
+(``/root/reference/src/checker/bfs.rs``). Where the reference runs N worker
+threads popping 1500-state blocks from a ``JobBroker`` and deduplicating
+through a concurrent ``DashMap``, this checker advances the search one
+*wave* at a time entirely on device:
+
+    frontier batch ──vmap(packed_step over F×A grid)──▶ candidates
+      ──fingerprint (u32-pair murmur fold)──▶ keys
+      ──sort-dedup within wave──▶ wave-unique keys
+      ──scatter-claim insert into device hash set──▶ fresh mask
+      ──masked-cumsum compaction──▶ next frontier
+
+Per-wave, the host receives only: scalar counters, per-property discovery
+fingerprints, and the (child fp, parent fp) pairs needed for TLC-style path
+reconstruction (Yu/Manolios/Lamport), which replays the *host* model along
+the fingerprint trail exactly like the reference
+(``/root/reference/src/checker/path.rs:20-97``).
+
+Semantics parity notes (all mirrored from the reference):
+- ``eventually`` bits propagate along paths and are NOT part of the
+  fingerprint, reproducing the documented false-negative on DAG joins and
+  cycles (``/root/reference/src/checker/bfs.rs:285-305``).
+- ``target_state_count``/``target_max_depth`` may overshoot by up to a wave
+  (the reference overshoots by up to a block, ``src/checker.rs:234-236``).
+- Symmetry reduction is ignored, matching the reference's BFS (only its
+  DFS/simulation checkers apply symmetry).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.batch import BatchableModel
+from ..core.model import Expectation
+from ..core.path import Path
+from ..ops.fingerprint import fingerprint_state, fp_to_int
+from ..ops.hashset import hashset_insert, hashset_new
+from .base import Checker
+
+_DEPTH_INF = (1 << 31) - 1
+_U32_MAX = np.uint32(0xFFFFFFFF)  # numpy: keeps module import backend-free
+# Grow the device hash set before load factor can exceed this.
+_MAX_LOAD = 0.55
+
+
+def _pow2ceil(n: int) -> int:
+    return 1 << max(0, (n - 1).bit_length())
+
+
+class TpuBfsChecker(Checker):
+    """Requires the model to implement ``BatchableModel``.
+
+    ``frontier_capacity`` caps lanes per wave (larger frontiers split into
+    chunks); ``table_capacity`` is the initial device hash-set size (grows
+    by doubling + rehash).
+    """
+
+    def __init__(self, options, frontier_capacity=1 << 13, table_capacity=1 << 16):
+        model = options.model
+        if not isinstance(model, BatchableModel):
+            raise TypeError(
+                f"spawn_tpu_bfs requires a BatchableModel; {type(model).__name__} "
+                "does not implement the packed protocol (see stateright_tpu.core.batch)"
+            )
+        self._model = model
+        self._properties = model.properties()
+        self._conditions = model.packed_conditions()
+        if len(self._conditions) != len(self._properties):
+            raise ValueError(
+                "packed_conditions() must align 1:1 with properties(): "
+                f"{len(self._conditions)} != {len(self._properties)}"
+            )
+        eventually = [
+            i
+            for i, p in enumerate(self._properties)
+            if p.expectation == Expectation.EVENTUALLY
+        ]
+        if len(eventually) > 32:
+            raise ValueError("at most 32 eventually properties supported")
+        self._ebit: Dict[int, int] = {pi: b for b, pi in enumerate(eventually)}
+        self._ebits0 = sum(1 << b for b in self._ebit.values())
+        self._A = model.packed_action_count()
+        # _enqueue's chunk arithmetic (pow2 slice sizes at F_max-multiple
+        # offsets staying within the padded buffer) requires a pow2 cap.
+        self._F_max = _pow2ceil(frontier_capacity)
+        self._capacity = table_capacity
+        self._visitor = options._visitor
+        self._target_state_count: Optional[int] = options._target_state_count
+        self._depth_cap = options._target_max_depth or _DEPTH_INF
+
+        self._state_count = 0
+        self._unique_count = 0
+        self._max_depth = 0
+        self._discoveries_fp: Dict[str, int] = {}
+        # (child fps u64, parent fps u64 — 0 encodes "init state") per wave.
+        self._wave_log: List = []
+        self._parent_map: Dict[int, Optional[int]] = {}
+        self._ingested = 0
+        self._ingest_lock = threading.Lock()
+        self._done_event = threading.Event()
+        self._error: Optional[BaseException] = None
+
+        self._jit_wave = jax.jit(self._wave)
+        self._jit_init = jax.jit(self._init_wave)
+        self._jit_take = jax.jit(self._take, static_argnums=(3,))
+        self._jit_pad = jax.jit(self._pad, static_argnums=(1,))
+        self._jit_rehash = jax.jit(self._rehash)
+        self._jit_fp_single = jax.jit(fingerprint_state)
+
+        self._handles = [
+            threading.Thread(target=self._run, name="tpu-bfs", daemon=True)
+        ]
+        self._handles[0].start()
+
+    # -- device functions (jitted) ----------------------------------------
+
+    def _init_wave(self, table):
+        states = self._model.packed_init_states()
+        valid = jax.vmap(self._model.packed_within_boundary)(states)
+        hi, lo = jax.vmap(fingerprint_state)(states)
+        n0 = hi.shape[0]
+        shi = jnp.where(valid, hi, _U32_MAX)
+        slo = jnp.where(valid, lo, _U32_MAX)
+        shi, slo, sidx = jax.lax.sort(
+            (shi, slo, jnp.arange(n0, dtype=jnp.int32)), num_keys=2
+        )
+        uniq = jnp.concatenate(
+            [jnp.ones((1,), bool), (shi[1:] != shi[:-1]) | (slo[1:] != slo[:-1])]
+        )
+        wave_unique = valid[sidx] & uniq
+        table, fresh, _found, pending = hashset_insert(table, shi, slo, wave_unique)
+        return {
+            "table": table,
+            "states": states,
+            "valid": valid,
+            "hi": hi,
+            "lo": lo,
+            "n_unique": fresh.sum(),
+            "n_valid": valid.sum(),
+            "overflow": pending.sum(),
+        }
+
+    def _wave(self, table, states, hi, lo, ebits, depth, mask, depth_cap):
+        model = self._model
+        A = self._A
+        F = hi.shape[0]
+        B = F * A
+        eval_mask = mask & (depth < depth_cap)
+
+        # Property conditions on the frontier (the states being "popped").
+        cond_vals = [jax.vmap(c)(states) for c in self._conditions]
+        ebits_after = ebits
+        for pi, b in self._ebit.items():
+            ebits_after = jnp.where(
+                cond_vals[pi], ebits_after & ~jnp.uint32(1 << b), ebits_after
+            )
+
+        # Expand the F × A action grid.
+        aids = jnp.arange(A, dtype=jnp.int32)
+        cand, cvalid = jax.vmap(
+            lambda s: jax.vmap(lambda a: model.packed_step(s, a))(aids)
+        )(states)
+        cvalid = cvalid & eval_mask[:, None]
+        cvalid = cvalid & jax.vmap(jax.vmap(model.packed_within_boundary))(cand)
+        generated = cvalid.sum(dtype=jnp.int32)
+        terminal = eval_mask & ~cvalid.any(axis=1)
+
+        # Fingerprint all candidates, dedup within the wave by sorting.
+        cand_flat = jax.tree_util.tree_map(
+            lambda x: x.reshape((B,) + x.shape[2:]), cand
+        )
+        cvalid_flat = cvalid.reshape(B)
+        chi, clo = jax.vmap(fingerprint_state)(cand_flat)
+        shi = jnp.where(cvalid_flat, chi, _U32_MAX)
+        slo = jnp.where(cvalid_flat, clo, _U32_MAX)
+        shi, slo, sidx = jax.lax.sort(
+            (shi, slo, jnp.arange(B, dtype=jnp.int32)), num_keys=2
+        )
+        uniq = jnp.concatenate(
+            [jnp.ones((1,), bool), (shi[1:] != shi[:-1]) | (slo[1:] != slo[:-1])]
+        )
+        wave_unique = cvalid_flat[sidx] & uniq
+
+        # Claim slots in the visited set; fresh lanes form the next frontier.
+        table, fresh, _found, pending = hashset_insert(table, shi, slo, wave_unique)
+        overflow = pending.sum()
+        n_new = fresh.sum()
+
+        # Compact fresh lanes (sorted order) into prefix slots.
+        pos = jnp.cumsum(fresh.astype(jnp.int32)) - 1
+        out_slot = jnp.where(fresh, pos, B)
+        zi = jnp.zeros((B,), jnp.int32)
+        zu = jnp.zeros((B,), jnp.uint32)
+        src_idx = zi.at[out_slot].set(sidx, mode="drop")
+        parent_row = sidx // A
+        new_states = jax.tree_util.tree_map(lambda x: x[src_idx], cand_flat)
+        out = {
+            "table": table,
+            "generated": generated,
+            "n_new": n_new,
+            "overflow": overflow,
+            "max_depth": jnp.max(jnp.where(mask, depth, 0)),
+            "new": {
+                "states": new_states,
+                "hi": zu.at[out_slot].set(shi, mode="drop"),
+                "lo": zu.at[out_slot].set(slo, mode="drop"),
+                "ebits": zu.at[out_slot].set(ebits_after[parent_row], mode="drop"),
+                "depth": zi.at[out_slot].set(depth[parent_row] + 1, mode="drop"),
+            },
+            "parent_hi": zu.at[out_slot].set(hi[parent_row], mode="drop"),
+            "parent_lo": zu.at[out_slot].set(lo[parent_row], mode="drop"),
+        }
+
+        # Per-property discovery scan over the evaluated frontier.
+        hits, fhis, flos = [], [], []
+        for i, p in enumerate(self._properties):
+            if p.expectation == Expectation.ALWAYS:
+                h = eval_mask & ~cond_vals[i]
+            elif p.expectation == Expectation.SOMETIMES:
+                h = eval_mask & cond_vals[i]
+            else:  # EVENTUALLY: unmet bit at a terminal state
+                b = self._ebit[i]
+                h = terminal & (((ebits_after >> jnp.uint32(b)) & 1) == 1)
+            idx = jnp.argmax(h)
+            hits.append(h.any())
+            fhis.append(hi[idx])
+            flos.append(lo[idx])
+        if self._properties:
+            out["prop_hit"] = jnp.stack(hits)
+            out["prop_hi"] = jnp.stack(fhis)
+            out["prop_lo"] = jnp.stack(flos)
+        return out
+
+    def _take(self, arrs, n_new, start, size):
+        sliced = jax.tree_util.tree_map(
+            lambda x: jax.lax.dynamic_slice_in_dim(x, start, size, axis=0), arrs
+        )
+        sliced["mask"] = (jnp.arange(size, dtype=jnp.int32) + start) < n_new
+        return sliced
+
+    def _pad(self, arrs, target):
+        def pad(x):
+            n = x.shape[0]
+            if n == target:
+                return x
+            widths = [(0, target - n)] + [(0, 0)] * (x.ndim - 1)
+            return jnp.pad(x, widths)
+
+        return jax.tree_util.tree_map(pad, arrs)
+
+    def _rehash(self, old_table, new_table):
+        active = (old_table[:, 0] != 0) | (old_table[:, 1] != 0)
+        new_table, _fresh, _found, pending = hashset_insert(
+            new_table, old_table[:, 0], old_table[:, 1], active
+        )
+        return new_table, pending.sum()
+
+    # -- host exploration loop ---------------------------------------------
+
+    def _run(self):
+        try:
+            self._explore()
+        except BaseException as e:  # noqa: BLE001 - surfaced via worker_error
+            self._error = e
+        finally:
+            self._done_event.set()
+
+    def _grow_table(self, table, min_capacity):
+        capacity = self._capacity
+        while capacity < min_capacity:
+            capacity *= 2
+        new_table, leftover = self._jit_rehash(table, hashset_new(capacity))
+        if int(leftover):
+            raise RuntimeError("device hash set rehash overflowed probe cap")
+        self._capacity = capacity
+        return new_table
+
+    def _explore(self):
+        props = self._properties
+        table = hashset_new(self._capacity)
+        while True:
+            out = self._jit_init(table)
+            if not int(out["overflow"]):
+                break
+            table = hashset_new(self._capacity * 2)
+            self._capacity *= 2
+        table = out["table"]
+        self._state_count = int(out["n_valid"])
+        self._unique_count = int(out["n_unique"])
+        hi = np.asarray(out["hi"])
+        lo = np.asarray(out["lo"])
+        valid = np.asarray(out["valid"])
+        child64 = ((hi.astype(np.uint64) << np.uint64(32)) | lo.astype(np.uint64))[
+            valid
+        ]
+        self._wave_log.append((child64, np.zeros_like(child64)))
+
+        F0 = hi.shape[0]
+        queue = deque()
+        queue.append(
+            {
+                "states": out["states"],
+                "hi": out["hi"],
+                "lo": out["lo"],
+                "ebits": jnp.full((F0,), self._ebits0, jnp.uint32),
+                "depth": jnp.ones((F0,), jnp.int32),
+                "mask": out["valid"],
+            }
+        )
+        depth_cap = jnp.int32(self._depth_cap)
+
+        while queue:
+            if not props:
+                break
+            if len(self._discoveries_fp) == len(props):
+                break
+            if (
+                self._target_state_count is not None
+                and self._target_state_count <= self._state_count
+            ):
+                break
+            chunk = queue.popleft()
+            F = chunk["hi"].shape[0]
+            B = F * self._A
+            if (self._unique_count + B) > _MAX_LOAD * self._capacity:
+                table = self._grow_table(
+                    table, _pow2ceil(int((self._unique_count + B) / _MAX_LOAD))
+                )
+
+            attempt = 0
+            while True:
+                wave = self._jit_wave(
+                    table,
+                    chunk["states"],
+                    chunk["hi"],
+                    chunk["lo"],
+                    chunk["ebits"],
+                    chunk["depth"],
+                    chunk["mask"],
+                    depth_cap,
+                )
+                table = wave["table"]
+                if attempt == 0:
+                    self._state_count += int(wave["generated"])
+                    self._max_depth = max(self._max_depth, int(wave["max_depth"]))
+                    if props:
+                        hit = np.asarray(wave["prop_hit"])
+                        phi = np.asarray(wave["prop_hi"])
+                        plo = np.asarray(wave["prop_lo"])
+                        for i, p in enumerate(props):
+                            if hit[i] and p.name not in self._discoveries_fp:
+                                self._discoveries_fp[p.name] = fp_to_int(
+                                    phi[i], plo[i]
+                                )
+                    if self._visitor is not None:
+                        self._visit_chunk(chunk)
+                n_new = int(wave["n_new"])
+                self._unique_count += n_new
+                if n_new:
+                    self._log_wave(wave, n_new)
+                    self._enqueue(queue, wave, n_new, B)
+                if not int(wave["overflow"]):
+                    break
+                table = self._grow_table(table, self._capacity * 2)
+                attempt += 1
+
+    def _log_wave(self, wave, n_new):
+        hi = np.asarray(wave["new"]["hi"])[:n_new].astype(np.uint64)
+        lo = np.asarray(wave["new"]["lo"])[:n_new].astype(np.uint64)
+        phi = np.asarray(wave["parent_hi"])[:n_new].astype(np.uint64)
+        plo = np.asarray(wave["parent_lo"])[:n_new].astype(np.uint64)
+        self._wave_log.append(
+            ((hi << np.uint64(32)) | lo, (phi << np.uint64(32)) | plo)
+        )
+
+    def _enqueue(self, queue, wave, n_new, B):
+        arrs = dict(wave["new"])
+        padded = self._jit_pad(arrs, _pow2ceil(B))
+        n_new_dev = jnp.int32(n_new)
+        for start in range(0, n_new, self._F_max):
+            size = _pow2ceil(min(self._F_max, n_new - start))
+            queue.append(
+                self._jit_take(padded, n_new_dev, jnp.int32(start), size)
+            )
+
+    def _visit_chunk(self, chunk):
+        mask = np.asarray(chunk["mask"])
+        depth = np.asarray(chunk["depth"])
+        hi = np.asarray(chunk["hi"])
+        lo = np.asarray(chunk["lo"])
+        for i in range(len(mask)):
+            if mask[i] and depth[i] < self._depth_cap:
+                self._visitor.visit(
+                    self._model, self._reconstruct(fp_to_int(hi[i], lo[i]))
+                )
+
+    # -- path reconstruction ----------------------------------------------
+
+    def _host_fp(self, host_state) -> int:
+        hi, lo = self._jit_fp_single(self._model.pack_state(host_state))
+        return fp_to_int(hi, lo)
+
+    def _ingest_wave_log(self):
+        # Raced by the worker (visitor reconstruction) and the user thread
+        # (mid-run discoveries()); must not skip a wave.
+        with self._ingest_lock:
+            while self._ingested < len(self._wave_log):
+                children, parents = self._wave_log[self._ingested]
+                for c, p in zip(children.tolist(), parents.tolist()):
+                    if c not in self._parent_map:
+                        self._parent_map[c] = p if p else None
+                self._ingested += 1
+
+    def _reconstruct(self, fp: int) -> Path:
+        self._ingest_wave_log()
+        chain: deque = deque()
+        cur: Optional[int] = fp
+        while cur is not None:
+            chain.appendleft(cur)
+            cur = self._parent_map.get(cur)
+        return Path.from_fingerprints(self._model, chain, fp_of=self._host_fp)
+
+    # -- Checker surface ---------------------------------------------------
+
+    def model(self):
+        return self._model
+
+    def state_count(self) -> int:
+        return max(self._state_count, self._unique_count)
+
+    def unique_state_count(self) -> int:
+        return self._unique_count
+
+    def max_depth(self) -> int:
+        return self._max_depth
+
+    def discoveries(self) -> Dict[str, Path]:
+        return {
+            name: self._reconstruct(fp)
+            for name, fp in list(self._discoveries_fp.items())
+        }
+
+    def handles(self) -> List[threading.Thread]:
+        handles, self._handles = self._handles, []
+        return handles
+
+    def is_done(self) -> bool:
+        return self._done_event.is_set()
+
+    def worker_error(self) -> Optional[BaseException]:
+        return self._error

@@ -1,0 +1,87 @@
+"""The packed-state protocol: models whose transitions stage onto the TPU.
+
+The reference's ``Model`` trait enumerates actions into a growable ``Vec``
+(``/root/reference/src/lib.rs:172-184``) — data-dependent arity that cannot
+be traced. A ``BatchableModel`` additionally exposes its transition relation
+in fixed-width form (SURVEY §7 stage 5a):
+
+- states are pytrees of fixed-shape arrays (the "packed" representation);
+- the action set is a *static* dense range ``0..packed_action_count``; each
+  action id either applies (guard true) or reports invalid — the analog of
+  the reference enumerating only enabled actions;
+- ``packed_step`` is jax-traceable over one (state, action_id) and is
+  vmapped by the checkers over frontier × action grids;
+- properties are traceable predicates aligned 1:1 with ``properties()``.
+
+Packed and host representations must agree: ``pack_state``/``unpack_state``
+convert between them, and two host states are equal iff their packed forms
+are identical (this is what makes device fingerprints usable for dedup and
+path replay).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Tuple
+
+import jax
+
+PackedState = Any  # pytree of arrays
+
+
+class BatchableModel:
+    """Mixin protocol implemented by models that support the TPU backends.
+
+    A class typically subclasses both ``Model`` (host path: exact oracles,
+    Explorer, paths) and ``BatchableModel`` (device path: TpuBfs, TPU
+    simulation). The device checkers verify counts against the host path in
+    the parity test suite.
+    """
+
+    # -- static shape info -------------------------------------------------
+
+    def packed_action_count(self) -> int:
+        """Static upper bound on actions per state (dense action ids)."""
+        raise NotImplementedError
+
+    # -- traceable transition relation ------------------------------------
+
+    def packed_init_states(self) -> PackedState:
+        """All initial states, stacked along a leading batch axis."""
+        raise NotImplementedError
+
+    def packed_step(
+        self, state: PackedState, action_id: jax.Array
+    ) -> Tuple[PackedState, jax.Array]:
+        """One unbatched transition: ``(state, action_id) -> (next, valid)``.
+
+        ``valid`` is a scalar bool: False when the action's guard does not
+        hold in ``state`` (the action would not have been enumerated by the
+        host model) or when the transition is a pruned no-op (the host
+        ``next_state`` returned None). Checkers vmap this over
+        frontier × action grids, so it must be jax-traceable with no
+        data-dependent python control flow.
+        """
+        raise NotImplementedError
+
+    def packed_conditions(self) -> List[Callable[[PackedState], jax.Array]]:
+        """Traceable predicates aligned with ``properties()`` (same order).
+
+        Each maps one unbatched packed state to a scalar bool.
+        """
+        raise NotImplementedError
+
+    def packed_within_boundary(self, state: PackedState) -> jax.Array:
+        """Traceable analog of ``within_boundary`` (scalar bool)."""
+        import jax.numpy as jnp
+
+        return jnp.bool_(True)
+
+    # -- host interop ------------------------------------------------------
+
+    def pack_state(self, host_state: Any) -> PackedState:
+        """Packs one host state into (numpy/jax) arrays."""
+        raise NotImplementedError
+
+    def unpack_state(self, packed: PackedState) -> Any:
+        """Unpacks one packed state (concrete arrays) into a host state."""
+        raise NotImplementedError

@@ -30,19 +30,28 @@ class Path(Generic[State, Action]):
         self._steps = steps
 
     @staticmethod
-    def from_fingerprints(model, fingerprints: Sequence[Fingerprint]) -> "Path":
-        """Reconstructs a path by replaying the model along a fingerprint trail."""
+    def from_fingerprints(
+        model, fingerprints: Sequence[Fingerprint], fp_of=None
+    ) -> "Path":
+        """Reconstructs a path by replaying the model along a fingerprint trail.
+
+        ``fp_of`` overrides the fingerprint function (default: the stable host
+        ``fingerprint``). The TPU checkers pass their device fingerprint of the
+        packed state so host replay matches device-recorded trails.
+        """
+        if fp_of is None:
+            fp_of = fingerprint
         fps = list(fingerprints)
         if not fps:
             raise ValueError("empty path is invalid")
         init_print = fps[0]
         last_state = None
         for s in model.init_states():
-            if fingerprint(s) == init_print:
+            if fp_of(s) == init_print:
                 last_state = s
                 break
         if last_state is None:
-            available = [fingerprint(s) for s in model.init_states()]
+            available = [fp_of(s) for s in model.init_states()]
             raise RuntimeError(
                 f"""
 Unable to reconstruct a `Path` based on digests ("fingerprints") from states visited earlier. No
@@ -56,11 +65,11 @@ Available init fingerprints (none of which match): {available}"""
         for next_fp in fps[1:]:
             found = None
             for a, s in model.next_steps(last_state):
-                if fingerprint(s) == next_fp:
+                if fp_of(s) == next_fp:
                     found = (a, s)
                     break
             if found is None:
-                available = [fingerprint(s) for s in model.next_states(last_state)]
+                available = [fp_of(s) for s in model.next_states(last_state)]
                 raise RuntimeError(
                     f"""
 Unable to reconstruct a `Path` based on digests ("fingerprints") from states visited earlier.

@@ -5,9 +5,11 @@ State: per-RM states + transaction-manager state + prepared flags + a message
 set. Exact oracle counts: 3 RMs = 288 states, 5 RMs = 8,832, 5 RMs with
 symmetry = 665.
 
-Reference: ``/root/reference/examples/2pc.rs``. The packed TPU counterpart is
-``stateright_tpu.models.packed_two_phase_commit`` (state fits in a few u32s:
-``Message::Prepared{rm}`` bounds the message set to N+2 distinct values).
+Reference: ``/root/reference/examples/2pc.rs``. ``TwoPhaseSys`` also
+implements the ``BatchableModel`` packed protocol — the state fits in a few
+u32 words (``Message::Prepared{rm}`` bounds the message set to N+2 distinct
+values, so it packs into one bitmask), making this the minimum end-to-end
+TPU slice per SURVEY §7.
 """
 
 from __future__ import annotations
@@ -15,6 +17,9 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import FrozenSet, List, Tuple
 
+import numpy as np
+
+from ..core.batch import BatchableModel
 from ..core.model import Model, Property
 from ..utils.rewrite import RewritePlan
 
@@ -51,7 +56,14 @@ class TwoPhaseState:
         )
 
 
-class TwoPhaseSys(Model):
+# Packed codes (uint32). Order matters only for the packed representation.
+_RM_CODE = {WORKING: 0, PREPARED: 1, COMMITTED: 2, ABORTED: 3}
+_RM_NAME = {v: k for k, v in _RM_CODE.items()}
+_TM_CODE = {TM_INIT: 0, TM_COMMITTED: 1, TM_ABORTED: 2}
+_TM_NAME = {v: k for k, v in _TM_CODE.items()}
+
+
+class TwoPhaseSys(Model, BatchableModel):
     def __init__(self, rm_count: int):
         self.rm_count = rm_count
 
@@ -130,3 +142,143 @@ class TwoPhaseSys(Model):
                 ),
             ),
         ]
+
+    # -- BatchableModel (packed protocol) ----------------------------------
+    #
+    # Packed state layout (all uint32):
+    #   rm:       (N,) per-RM code (0=Working 1=Prepared 2=Committed 3=Aborted)
+    #   tm:       ()   TM code     (0=Init 1=Committed 2=Aborted)
+    #   prepared: ()   bitmask of tm_prepared flags
+    #   msgs:     ()   bitmask: bit rm = Prepared{rm}, bit N = Commit,
+    #                  bit N+1 = Abort
+    #
+    # Dense action ids (A = 2 + 5N):
+    #   0 = TmCommit, 1 = TmAbort,
+    #   2 + rm*5 + k with k: 0=TmRcvPrepared 1=RmPrepare 2=RmChooseToAbort
+    #                        3=RmRcvCommitMsg 4=RmRcvAbortMsg
+
+    def packed_action_count(self) -> int:
+        return 2 + 5 * self.rm_count
+
+    def packed_init_states(self):
+        import jax.numpy as jnp
+
+        n = self.rm_count
+        return {
+            "rm": jnp.zeros((1, n), jnp.uint32),
+            "tm": jnp.zeros((1,), jnp.uint32),
+            "prepared": jnp.zeros((1,), jnp.uint32),
+            "msgs": jnp.zeros((1,), jnp.uint32),
+        }
+
+    def packed_step(self, state, action_id):
+        import jax.numpy as jnp
+
+        n = self.rm_count
+        aid = action_id.astype(jnp.int32)
+        rm = jnp.clip((aid - 2) // 5, 0, n - 1)
+        k = (aid - 2) % 5
+        is_rm = aid >= 2
+        rmu = rm.astype(jnp.uint32)
+        bit = jnp.uint32(1) << rmu
+        rms, tm = state["rm"], state["tm"]
+        prepared, msgs = state["prepared"], state["msgs"]
+
+        tm_init = tm == 0
+        all_prepared = prepared == jnp.uint32((1 << n) - 1)
+        commit_in = ((msgs >> jnp.uint32(n)) & 1) == 1
+        abort_in = ((msgs >> jnp.uint32(n + 1)) & 1) == 1
+        prep_msg_in = ((msgs >> rmu) & 1) == 1
+        rm_working = rms[rm] == 0
+
+        valid = jnp.select(
+            [aid == 0, aid == 1, k == 0, k == 1, k == 2, k == 3],
+            [
+                tm_init & all_prepared,
+                tm_init,
+                tm_init & prep_msg_in,
+                rm_working,
+                rm_working,
+                commit_in,
+            ],
+            abort_in,  # k == 4
+        )
+
+        u0 = jnp.uint32(0)
+        new_tm = jnp.where(
+            aid == 0, jnp.uint32(1), jnp.where(aid == 1, jnp.uint32(2), tm)
+        )
+        new_msgs = (
+            msgs
+            | jnp.where(aid == 0, jnp.uint32(1 << n), u0)
+            | jnp.where(aid == 1, jnp.uint32(1 << (n + 1)), u0)
+            | jnp.where(is_rm & (k == 1), bit, u0)
+        )
+        new_prepared = prepared | jnp.where(is_rm & (k == 0), bit, u0)
+        # k: 1=Prepare→1, 2=ChooseToAbort→3, 3=RcvCommit→2, 4=RcvAbort→3
+        rm_val = jnp.select(
+            [k == 1, k == 2, k == 3], [jnp.uint32(1), jnp.uint32(3), jnp.uint32(2)],
+            jnp.uint32(3),
+        )
+        writes_rm = is_rm & (k != 0)
+        new_rms = jnp.where(
+            (jnp.arange(n) == rm) & writes_rm, rm_val, rms
+        ).astype(jnp.uint32)
+        next_state = {
+            "rm": new_rms,
+            "tm": new_tm,
+            "prepared": new_prepared,
+            "msgs": new_msgs,
+        }
+        return next_state, valid
+
+    def packed_conditions(self):
+        import jax.numpy as jnp
+
+        return [
+            lambda st: jnp.all(st["rm"] == 3),  # abort agreement
+            lambda st: jnp.all(st["rm"] == 2),  # commit agreement
+            lambda st: ~(jnp.any(st["rm"] == 3) & jnp.any(st["rm"] == 2)),
+        ]
+
+    def pack_state(self, host_state: TwoPhaseState):
+        n = self.rm_count
+        msgs = 0
+        for m in host_state.msgs:
+            if m[0] == "Prepared":
+                msgs |= 1 << m[1]
+            elif m == COMMIT_MSG:
+                msgs |= 1 << n
+            elif m == ABORT_MSG:
+                msgs |= 1 << (n + 1)
+        prepared = 0
+        for i, flag in enumerate(host_state.tm_prepared):
+            if flag:
+                prepared |= 1 << i
+        return {
+            "rm": np.array(
+                [_RM_CODE[s] for s in host_state.rm_state], np.uint32
+            ),
+            "tm": np.uint32(_TM_CODE[host_state.tm_state]),
+            "prepared": np.uint32(prepared),
+            "msgs": np.uint32(msgs),
+        }
+
+    def unpack_state(self, packed) -> TwoPhaseState:
+        n = self.rm_count
+        msgs_mask = int(packed["msgs"])
+        msgs = set()
+        for rm in range(n):
+            if msgs_mask & (1 << rm):
+                msgs.add(prepared_msg(rm))
+        if msgs_mask & (1 << n):
+            msgs.add(COMMIT_MSG)
+        if msgs_mask & (1 << (n + 1)):
+            msgs.add(ABORT_MSG)
+        prepared = int(packed["prepared"])
+        return TwoPhaseState(
+            rm_state=tuple(_RM_NAME[int(c)] for c in np.asarray(packed["rm"])),
+            tm_state=_TM_NAME[int(packed["tm"])],
+            tm_prepared=tuple(bool(prepared & (1 << i)) for i in range(n)),
+            msgs=frozenset(msgs),
+        )

@@ -1,0 +1,125 @@
+"""Device-side 64-bit state fingerprinting over packed (array) states.
+
+The host checkers hash a canonical byte encoding with blake2b
+(``stateright_tpu.core.fingerprint``). On device, states are fixed-shape
+pytrees of arrays; this module flattens them to a vector of uint32 words and
+folds a murmur3-style mix over the words **twice with independent seeds**,
+yielding a (hi, lo) pair of uint32 lanes = one 64-bit fingerprint.
+
+Device fingerprints only need to be *stable within the device backend* — path
+reconstruction replays the packed model and re-fingerprints with this same
+function (reference requirement analog: fixed-seed ahash at
+``/root/reference/src/lib.rs:357-375``). Fingerprints are kept as u32 pairs
+(not u64) because TPUs have no native 64-bit integer path; all dedup
+machinery sorts/compares lexicographically on (hi, lo).
+
+The all-zero pair is reserved as the hash-set empty sentinel; fingerprints
+are nudged to (0, 1) if they collide with it.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["state_words", "fingerprint_words", "fingerprint_state", "fp_to_int"]
+
+_C1 = 0xCC9E2D51
+_C2 = 0x1B873593
+_SEED_HI = 0x9747B28C
+_SEED_LO = 0x3C6EF372
+
+
+def _rotl(x: jax.Array, r: int) -> jax.Array:
+    return (x << r) | (x >> (32 - r))
+
+
+def _mm3_round(h: jax.Array, k: jax.Array) -> jax.Array:
+    k = k * jnp.uint32(_C1)
+    k = _rotl(k, 15)
+    k = k * jnp.uint32(_C2)
+    h = h ^ k
+    h = _rotl(h, 13)
+    return h * jnp.uint32(5) + jnp.uint32(0xE6546B64)
+
+
+def _fmix(h: jax.Array) -> jax.Array:
+    h = h ^ (h >> 16)
+    h = h * jnp.uint32(0x85EBCA6B)
+    h = h ^ (h >> 13)
+    h = h * jnp.uint32(0xC2B2AE35)
+    return h ^ (h >> 16)
+
+
+def _leaf_words(leaf: jax.Array) -> jax.Array:
+    """A leaf of a single (unbatched) packed state as a 1-D uint32 vector."""
+    x = jnp.asarray(leaf)
+    if x.dtype == jnp.bool_:
+        x = x.astype(jnp.uint32)
+    elif x.dtype in (jnp.int8, jnp.uint8, jnp.int16, jnp.uint16):
+        x = x.astype(jnp.uint32)
+    elif x.dtype == jnp.int32:
+        x = jax.lax.bitcast_convert_type(x, jnp.uint32)
+    elif x.dtype == jnp.float32:
+        x = jax.lax.bitcast_convert_type(x, jnp.uint32)
+    elif x.dtype != jnp.uint32:
+        raise TypeError(f"cannot fingerprint leaf dtype {x.dtype}")
+    return x.reshape(-1)
+
+
+def state_words(state: Any) -> jax.Array:
+    """Flattens a single packed state pytree to its canonical uint32 words.
+
+    The word layout is determined by the pytree structure, so two states of
+    the same model always flatten identically. Unordered containers must be
+    encoded canonically by the model itself (e.g. as bitmasks or sorted
+    rows); arrays hash positionally.
+    """
+    leaves = jax.tree_util.tree_leaves(state)
+    if not leaves:
+        raise ValueError("packed state has no array leaves")
+    return jnp.concatenate([_leaf_words(leaf) for leaf in leaves])
+
+
+def fingerprint_words(words: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """(hi, lo) uint32 fingerprint pair of a uint32 word vector.
+
+    Word count must be static (it is, for fixed-shape packed states).
+    """
+    n = words.shape[0]
+    hi = jnp.uint32(_SEED_HI)
+    lo = jnp.uint32(_SEED_LO)
+    if n <= 64:
+        # Unrolled: XLA fuses the whole fold into one elementwise chain.
+        for i in range(n):
+            w = words[i]
+            hi = _mm3_round(hi, w)
+            lo = _mm3_round(lo, w ^ jnp.uint32(0xA5A5A5A5))
+    else:
+        def body(carry, w):
+            h, l = carry
+            return (_mm3_round(h, w), _mm3_round(l, w ^ jnp.uint32(0xA5A5A5A5))), None
+
+        (hi, lo), _ = jax.lax.scan(body, (hi, lo), words)
+    hi = _fmix(hi ^ jnp.uint32(n * 4))
+    lo = _fmix(lo ^ jnp.uint32(n * 4 + 1))
+    # Reserve (0, 0) for the hash-set empty sentinel and (MAX, MAX) for the
+    # checkers' invalid-lane sort sentinel.
+    m = jnp.uint32(0xFFFFFFFF)
+    zero = (hi == 0) & (lo == 0)
+    lo = jnp.where(zero, jnp.uint32(1), lo)
+    maxed = (hi == m) & (lo == m)
+    lo = jnp.where(maxed, m - 1, lo)
+    return hi, lo
+
+
+def fingerprint_state(state: Any) -> Tuple[jax.Array, jax.Array]:
+    """(hi, lo) fingerprint of one packed state pytree. vmap over batches."""
+    return fingerprint_words(state_words(state))
+
+
+def fp_to_int(hi, lo) -> int:
+    """Host-side: a (hi, lo) pair as one python int fingerprint."""
+    return (int(hi) << 32) | int(lo)

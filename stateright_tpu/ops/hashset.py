@@ -1,0 +1,124 @@
+"""Device-resident fingerprint set: open addressing with scatter-claim insert.
+
+This replaces the reference's concurrent visited set (``DashMap`` keyed by
+fingerprint, ``/root/reference/src/checker/bfs.rs:28-29``) with an XLA-native
+structure: a ``(capacity, 2)`` uint32 table of (hi, lo) fingerprint pairs,
+linear probing, and batched insert where competing lanes claim empty slots
+via a row-window scatter (duplicate scatter indices resolve to exactly one
+winning row — XLA applies each update as an atomic window) and re-read to
+learn who won. Lanes that lose a claim race keep probing, exactly like a
+CAS-loop insert on CPU.
+
+Keys must be wave-unique before insertion (dedup by sort upstream) so a
+"slot holds my key" observation implies *this lane* inserted or the key was
+already present from an earlier wave — the two outcomes the checker needs to
+distinguish are disambiguated by ``fresh`` (claim won) vs ``found``.
+
+The all-zero pair is the empty sentinel (fingerprints are never (0, 0) —
+see ``ops.fingerprint``).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["hashset_new", "hashset_insert", "hashset_contains", "MAX_PROBES"]
+
+# Probe cap per insert; lanes still unplaced after this report overflow and
+# the host grows the table. With load factor kept under ~0.6 by the checker,
+# linear-probe clusters practically never approach this.
+MAX_PROBES = 128
+
+_SCRAMBLE = 0x9E3779B9
+
+
+def hashset_new(capacity: int) -> jax.Array:
+    """An empty table. ``capacity`` must be a power of two."""
+    assert capacity & (capacity - 1) == 0, "capacity must be a power of two"
+    return jnp.zeros((capacity, 2), dtype=jnp.uint32)
+
+
+def _probe_base(key_hi: jax.Array, key_lo: jax.Array) -> jax.Array:
+    return key_lo ^ (key_hi * jnp.uint32(_SCRAMBLE))
+
+
+def hashset_insert(
+    table: jax.Array,
+    key_hi: jax.Array,
+    key_lo: jax.Array,
+    active: jax.Array,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Inserts a batch of (wave-unique) keys.
+
+    Returns ``(table, fresh, found, overflow)`` where per lane:
+    - ``fresh``: this lane claimed an empty slot (key was NOT in the set);
+    - ``found``: key was already present;
+    - ``overflow``: probe cap exhausted (host must grow and retry).
+    Inactive lanes report none of the three.
+    """
+    capacity = table.shape[0]
+    mask = jnp.uint32(capacity - 1)
+    base = _probe_base(key_hi, key_lo)
+
+    def cond(carry):
+        _table, r, pending, _fresh, _found = carry
+        return (r < MAX_PROBES) & pending.any()
+
+    def body(carry):
+        table, r, pending, fresh, found = carry
+        idx = ((base + jnp.uint32(r)) & mask).astype(jnp.int32)
+        row = table[idx]
+        cur_hi, cur_lo = row[:, 0], row[:, 1]
+        empty = (cur_hi == 0) & (cur_lo == 0)
+        match = (cur_hi == key_hi) & (cur_lo == key_lo)
+        found = found | (pending & match)
+        attempt = pending & empty & ~match
+        # Claim: one full-row update wins per index; losers observe the
+        # winner's key on re-read and continue probing.
+        scatter_idx = jnp.where(attempt, idx, capacity)
+        update = jnp.stack([key_hi, key_lo], axis=-1)
+        table = table.at[scatter_idx].set(update, mode="drop")
+        row2 = table[idx]
+        won = attempt & (row2[:, 0] == key_hi) & (row2[:, 1] == key_lo)
+        fresh = fresh | won
+        pending = pending & ~match & ~won
+        return table, r + 1, pending, fresh, found
+
+    n = key_hi.shape[0]
+    falses = jnp.zeros((n,), dtype=bool)
+    table, _r, pending, fresh, found = jax.lax.while_loop(
+        cond, body, (table, jnp.int32(0), active, falses, falses)
+    )
+    return table, fresh, found, pending
+
+
+def hashset_contains(
+    table: jax.Array, key_hi: jax.Array, key_lo: jax.Array
+) -> jax.Array:
+    """Batched membership probe (no mutation)."""
+    capacity = table.shape[0]
+    mask = jnp.uint32(capacity - 1)
+    base = _probe_base(key_hi, key_lo)
+    n = key_hi.shape[0]
+
+    def cond(carry):
+        r, pending, _found = carry
+        return (r < MAX_PROBES) & pending.any()
+
+    def body(carry):
+        r, pending, found = carry
+        idx = ((base + jnp.uint32(r)) & mask).astype(jnp.int32)
+        row = table[idx]
+        empty = (row[:, 0] == 0) & (row[:, 1] == 0)
+        match = (row[:, 0] == key_hi) & (row[:, 1] == key_lo)
+        found = found | (pending & match)
+        pending = pending & ~match & ~empty
+        return r + 1, pending, found
+
+    _r, _pending, found = jax.lax.while_loop(
+        cond, body, (jnp.int32(0), jnp.ones((n,), bool), jnp.zeros((n,), bool))
+    )
+    return found

@@ -2,18 +2,28 @@
 
 Multi-chip hardware is not available in CI; sharding tests run on
 ``xla_force_host_platform_device_count=8`` per the project test strategy.
+
+Note: this image boots python through an ``axon`` sitecustomize that
+registers a tunneled TPU backend and forces ``jax_platforms=axon,cpu`` via
+``jax.config`` (overriding the ``JAX_PLATFORMS`` env var), so the config
+must be re-pinned to cpu *after* importing jax — env vars alone are not
+enough. Tests must never dispatch through the single-client TPU tunnel.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
-import sys
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import sys  # noqa: E402
 
 sys.path.insert(0, os.path.dirname(__file__))
 sys.path.insert(0, os.path.dirname(os.path.dirname(__file__)))

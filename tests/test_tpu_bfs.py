@@ -1,0 +1,234 @@
+"""TPU backend tests: device ops units + host/TPU exact-count parity.
+
+Parity strategy per SURVEY §4: the host checkers are the oracle; the TPU
+backend must reproduce their unique/total counts, depths, and discoveries
+on the reference workloads (2pc: 288 / 8,832) and on semantics fixtures
+(eventually bits, boundary, depth caps).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from stateright_tpu.core.batch import BatchableModel
+from stateright_tpu.core.model import Model, Property
+from stateright_tpu.core.visitor import PathRecorder
+from stateright_tpu.models.two_phase_commit import TwoPhaseSys
+from stateright_tpu.ops.fingerprint import fingerprint_state, fp_to_int
+from stateright_tpu.ops.hashset import hashset_contains, hashset_insert, hashset_new
+
+
+class Chain(Model, BatchableModel):
+    """0 -> 1 -> ... -> n (terminal); the liveness-semantics fixture.
+
+    ``reach`` sets the eventually target; a target > n is unreachable and
+    must produce a counterexample path ending at the terminal state.
+    """
+
+    def __init__(self, n, reach=None, bound=None):
+        self.n = n
+        self.reach = reach
+        self.bound = bound
+
+    # host side
+    def init_states(self):
+        return [0]
+
+    def actions(self, state, actions):
+        if state < self.n:
+            actions.append("inc")
+
+    def next_state(self, state, action):
+        return state + 1
+
+    def within_boundary(self, state):
+        return self.bound is None or state <= self.bound
+
+    def properties(self):
+        props = []
+        if self.reach is not None:
+            props.append(
+                Property.eventually("reach", lambda _m, s: s == self.reach)
+            )
+        props.append(Property.always("small", lambda _m, s: s <= self.n))
+        return props
+
+    # packed side
+    def packed_action_count(self):
+        return 1
+
+    def packed_init_states(self):
+        return jnp.zeros((1,), jnp.uint32)
+
+    def packed_step(self, state, action_id):
+        return state + 1, state < self.n
+
+    def packed_within_boundary(self, state):
+        if self.bound is None:
+            return jnp.bool_(True)
+        return state <= self.bound
+
+    def packed_conditions(self):
+        conds = []
+        if self.reach is not None:
+            conds.append(lambda s: s == self.reach)
+        conds.append(lambda s: s <= self.n)
+        return conds
+
+    def pack_state(self, host_state):
+        return np.uint32(host_state)
+
+    def unpack_state(self, packed):
+        return int(packed)
+
+
+def assert_parity(model, **tpu_kwargs):
+    tpu = model.checker().spawn_tpu_bfs(**tpu_kwargs).join()
+    host = model.checker().spawn_bfs().join()
+    assert tpu.unique_state_count() == host.unique_state_count()
+    assert tpu.state_count() == host.state_count()
+    assert tpu.max_depth() == host.max_depth()
+    assert set(tpu.discoveries()) == set(host.discoveries())
+    return tpu, host
+
+
+# -- device op units -------------------------------------------------------
+
+
+def test_fingerprint_deterministic_and_distinct():
+    a = {"x": jnp.uint32(1), "y": jnp.arange(4, dtype=jnp.uint32)}
+    b = {"x": jnp.uint32(2), "y": jnp.arange(4, dtype=jnp.uint32)}
+    fa1 = fp_to_int(*fingerprint_state(a))
+    fa2 = fp_to_int(*fingerprint_state(a))
+    fb = fp_to_int(*fingerprint_state(b))
+    assert fa1 == fa2
+    assert fa1 != fb
+    assert fa1 != 0
+
+
+def test_fingerprint_no_collisions_small_space():
+    # All 2^16 2-word states must hash distinctly (birthday bound @64-bit).
+    import jax
+
+    xs, ys = jnp.meshgrid(
+        jnp.arange(256, dtype=jnp.uint32), jnp.arange(256, dtype=jnp.uint32)
+    )
+    states = jnp.stack([xs.ravel(), ys.ravel()], axis=-1)
+    hi, lo = jax.vmap(fingerprint_state)(states)
+    combined = np.asarray(hi).astype(np.uint64) << np.uint64(32) | np.asarray(
+        lo
+    ).astype(np.uint64)
+    assert len(np.unique(combined)) == 65536
+
+
+def test_hashset_insert_and_membership():
+    table = hashset_new(256)
+    hi = jnp.arange(1, 101, dtype=jnp.uint32)
+    lo = hi * jnp.uint32(7)
+    active = jnp.ones((100,), bool)
+    table, fresh, found, overflow = hashset_insert(table, hi, lo, active)
+    assert int(fresh.sum()) == 100
+    assert int(found.sum()) == 0
+    assert int(overflow.sum()) == 0
+    # Re-insert: everything already present.
+    table, fresh2, found2, overflow2 = hashset_insert(table, hi, lo, active)
+    assert int(fresh2.sum()) == 0
+    assert int(found2.sum()) == 100
+    assert bool(hashset_contains(table, hi[:5], lo[:5]).all())
+    absent = hashset_contains(table, hi + jnp.uint32(1000), lo)
+    assert not bool(absent.any())
+
+
+def test_hashset_duplicate_probe_collisions():
+    # Many keys landing on the same probe chain still all insert.
+    table = hashset_new(128)
+    n = 64
+    lo = jnp.full((n,), 5, jnp.uint32)  # identical probe base ingredient
+    hi = jnp.arange(1, n + 1, dtype=jnp.uint32)
+    table, fresh, _found, overflow = hashset_insert(
+        table, hi, lo, jnp.ones((n,), bool)
+    )
+    assert int(fresh.sum()) == n
+    assert int(overflow.sum()) == 0
+
+
+# -- parity on the reference workload --------------------------------------
+
+
+def test_2pc_3rm_parity():
+    tpu, _host = assert_parity(
+        TwoPhaseSys(3), frontier_capacity=256, table_capacity=1024
+    )
+    assert tpu.unique_state_count() == 288
+    tpu.assert_properties()
+    tpu.assert_discovery(
+        "abort agreement",
+        [("TmAbort",)] + [("RmRcvAbortMsg", i) for i in range(3)],
+    )
+
+
+@pytest.mark.slow
+def test_2pc_5rm_parity():
+    tpu, _host = assert_parity(
+        TwoPhaseSys(5), frontier_capacity=1024, table_capacity=16384
+    )
+    assert tpu.unique_state_count() == 8832
+
+
+def test_table_growth_mid_run():
+    # Tiny initial table forces repeated grow+rehash during the check.
+    tpu = (
+        TwoPhaseSys(3)
+        .checker()
+        .spawn_tpu_bfs(frontier_capacity=64, table_capacity=64)
+        .join()
+    )
+    assert tpu.unique_state_count() == 288
+
+
+# -- semantics fixtures ----------------------------------------------------
+
+
+def test_eventually_satisfied_no_counterexample():
+    model = Chain(5, reach=5)
+    tpu, _ = assert_parity(model)
+    assert tpu.discoveries() == {}
+
+
+def test_eventually_counterexample_at_terminal():
+    model = Chain(5, reach=7)  # unreachable
+    tpu, host = assert_parity(model)
+    path = tpu.assert_any_discovery("reach")
+    assert path.into_states() == [0, 1, 2, 3, 4, 5]
+    assert host.assert_any_discovery("reach").into_states() == path.into_states()
+
+
+def test_target_max_depth_parity():
+    model = Chain(10)
+    tpu = model.checker().target_max_depth(3).spawn_tpu_bfs().join()
+    host = model.checker().target_max_depth(3).spawn_bfs().join()
+    assert tpu.unique_state_count() == host.unique_state_count() == 3
+    assert tpu.max_depth() == host.max_depth() == 3
+
+
+def test_within_boundary_parity():
+    model = Chain(10, bound=4)
+    tpu, host = assert_parity(model)
+    assert tpu.unique_state_count() == 5  # 0..4
+
+
+def test_visitor_paths_match_host():
+    model = Chain(4)
+    tpu_rec, host_rec = PathRecorder(), PathRecorder()
+    model.checker().visitor(tpu_rec).spawn_tpu_bfs().join()
+    model.checker().visitor(host_rec).spawn_bfs().join()
+    assert tpu_rec.paths == host_rec.paths
+    assert len(tpu_rec.paths) == 5
+
+
+def test_unbatchable_model_rejected():
+    from stateright_tpu.core.model import FnModel
+
+    model = FnModel(lambda s, out: out.append(0) if s is None else None)
+    with pytest.raises(TypeError, match="BatchableModel"):
+        model.checker().spawn_tpu_bfs()
