@@ -1,0 +1,99 @@
+"""Round benchmark: TPU BFS throughput on two-phase commit.
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
+
+Workload: exhaustive check of the 7-RM two-phase-commit model
+(296,448 unique states — the scaled-up version of the reference's
+``2pc check N`` bench config, ``/root/reference/bench.sh:27``) on the
+``TpuBfsChecker`` device backend. Baseline: the host ``BfsChecker`` on the
+same model, rate-sampled with a state-count cap so the bench stays fast;
+the reference itself publishes no absolute numbers (BASELINE.md).
+
+Diagnostics go to stderr; stdout carries only the JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+RM_COUNT = 7
+EXPECTED_UNIQUE = 296_448
+HOST_CAP = 30_000
+
+
+def log(*args):
+    print(*args, file=sys.stderr, flush=True)
+
+
+def main():
+    import jax
+
+    from stateright_tpu.models.two_phase_commit import TwoPhaseSys
+
+    device = jax.devices()[0]
+    log(f"bench device: {device.platform} ({device})")
+
+    t0 = time.time()
+    host = (
+        TwoPhaseSys(RM_COUNT)
+        .checker()
+        .target_state_count(HOST_CAP)
+        .spawn_bfs()
+        .join()
+    )
+    host_dt = time.time() - t0
+    host_rate = host.unique_state_count() / host_dt
+    log(
+        f"host BfsChecker: {host.unique_state_count()} unique "
+        f"in {host_dt:.2f}s = {host_rate:,.0f}/s (capped)"
+    )
+
+    t0 = time.time()
+    checker = (
+        TwoPhaseSys(RM_COUNT)
+        .checker()
+        .spawn_tpu_bfs(frontier_capacity=1 << 13, table_capacity=1 << 20)
+        .join()
+    )
+    tpu_dt = time.time() - t0
+    err = checker.worker_error()
+    if err is not None:
+        raise err
+    unique = checker.unique_state_count()
+    if unique != EXPECTED_UNIQUE:
+        raise AssertionError(
+            f"2pc-{RM_COUNT} count mismatch: {unique} != {EXPECTED_UNIQUE}"
+        )
+    checker.assert_properties()
+    # Exclude one-time XLA compilation (the time until the first wave
+    # returned) so the metric reports steady-state exploration throughput.
+    warmup = checker.warmup_seconds or 0.0
+    steady = max(tpu_dt - warmup, 1e-9)
+    tpu_rate = unique / steady
+    log(
+        f"TpuBfs: {unique} unique in {tpu_dt:.2f}s wall "
+        f"({warmup:.2f}s compile warmup) = {tpu_rate:,.0f}/s steady-state"
+    )
+
+    print(
+        json.dumps(
+            {
+                "metric": f"2pc-{RM_COUNT} exhaustive unique states/sec (TpuBfs)",
+                "value": round(tpu_rate, 1),
+                "unit": "unique states/sec",
+                "vs_baseline": round(tpu_rate / host_rate, 3),
+                "baseline": "host BfsChecker (Python), same model, capped run",
+                "unique_states": unique,
+                "wall_s": round(tpu_dt, 2),
+                "warmup_s": round(warmup, 2),
+                "device": device.platform,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -86,6 +86,14 @@ class CheckerBuilder:
 
         return TpuBfsChecker(self, **kwargs)
 
+    def spawn_sharded_tpu_bfs(self, mesh=None, **kwargs):
+        """Multi-device BFS over a ``jax.sharding.Mesh``: the visited set is
+        sharded by fingerprint range and candidate keys ride an all-to-all;
+        states never leave the device that generated them."""
+        from ..parallel.sharded import ShardedTpuBfsChecker
+
+        return ShardedTpuBfsChecker(self, mesh=mesh, **kwargs)
+
     def spawn_tpu_simulation(self, seed: int, lanes: int = 1024, **kwargs):
         """TPU-accelerated simulation: N vmapped random-walk lanes."""
         from .tpu_simulation import TpuSimulationChecker

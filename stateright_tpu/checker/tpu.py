@@ -31,6 +31,7 @@ Semantics parity notes (all mirrored from the reference):
 from __future__ import annotations
 
 import threading
+import time
 from collections import deque
 from typing import Dict, List, Optional
 
@@ -88,8 +89,9 @@ class TpuBfsChecker(Checker):
         self._ebit: Dict[int, int] = {pi: b for b, pi in enumerate(eventually)}
         self._ebits0 = sum(1 << b for b in self._ebit.values())
         self._A = model.packed_action_count()
-        # _enqueue's chunk arithmetic (pow2 slice sizes at F_max-multiple
-        # offsets staying within the padded buffer) requires a pow2 cap.
+        # Every wave runs at exactly this width (short chunks are masked),
+        # so the expansion kernel compiles once per run — recompilation
+        # through the device tunnel costs tens of seconds per shape.
         self._F_max = _pow2ceil(frontier_capacity)
         self._capacity = table_capacity
         self._visitor = options._visitor
@@ -110,8 +112,8 @@ class TpuBfsChecker(Checker):
 
         self._jit_wave = jax.jit(self._wave)
         self._jit_init = jax.jit(self._init_wave)
-        self._jit_take = jax.jit(self._take, static_argnums=(3,))
-        self._jit_pad = jax.jit(self._pad, static_argnums=(1,))
+        self._jit_take = jax.jit(self._take, static_argnums=(2,))
+        self._jit_finish = jax.jit(self._finish, static_argnums=(2,))
         self._jit_rehash = jax.jit(self._rehash)
         self._jit_fp_single = jax.jit(fingerprint_state)
 
@@ -239,14 +241,20 @@ class TpuBfsChecker(Checker):
             out["prop_lo"] = jnp.stack(flos)
         return out
 
-    def _take(self, arrs, n_new, start, size):
-        sliced = jax.tree_util.tree_map(
+    def _take(self, arrs, start, size):
+        return jax.tree_util.tree_map(
             lambda x: jax.lax.dynamic_slice_in_dim(x, start, size, axis=0), arrs
         )
-        sliced["mask"] = (jnp.arange(size, dtype=jnp.int32) + start) < n_new
-        return sliced
 
-    def _pad(self, arrs, target):
+    def _finish(self, arrs, n_new, target):
+        """Pads chunk arrays to ``target`` rows and attaches the lane mask.
+
+        Wave outputs are compacted (valid rows form a prefix), so the mask
+        derives from ``n_new``; the init frontier arrives uncompacted with
+        an explicit ``mask`` that is padded through instead.
+        """
+        has_mask = "mask" in arrs
+
         def pad(x):
             n = x.shape[0]
             if n == target:
@@ -254,7 +262,10 @@ class TpuBfsChecker(Checker):
             widths = [(0, target - n)] + [(0, 0)] * (x.ndim - 1)
             return jnp.pad(x, widths)
 
-        return jax.tree_util.tree_map(pad, arrs)
+        out = jax.tree_util.tree_map(pad, arrs)
+        if not has_mask:
+            out["mask"] = jnp.arange(target, dtype=jnp.int32) < n_new
+        return out
 
     def _rehash(self, old_table, new_table):
         active = (old_table[:, 0] != 0) | (old_table[:, 1] != 0)
@@ -284,6 +295,10 @@ class TpuBfsChecker(Checker):
         return new_table
 
     def _explore(self):
+        t_start = time.perf_counter()
+        # Wall-clock burned before the first wave returns — dominated by XLA
+        # compilation; benchmarks subtract it to report steady-state rate.
+        self.warmup_seconds: Optional[float] = None
         props = self._properties
         table = hashset_new(self._capacity)
         while True:
@@ -304,17 +319,19 @@ class TpuBfsChecker(Checker):
         self._wave_log.append((child64, np.zeros_like(child64)))
 
         F0 = hi.shape[0]
+        init_arrs = {
+            "states": out["states"],
+            "hi": out["hi"],
+            "lo": out["lo"],
+            "ebits": jnp.full((F0,), self._ebits0, jnp.uint32),
+            "depth": jnp.ones((F0,), jnp.int32),
+            "mask": out["valid"],
+        }
+        target0 = -(-F0 // self._F_max) * self._F_max
+        padded0 = self._jit_finish(init_arrs, jnp.int32(0), target0)
         queue = deque()
-        queue.append(
-            {
-                "states": out["states"],
-                "hi": out["hi"],
-                "lo": out["lo"],
-                "ebits": jnp.full((F0,), self._ebits0, jnp.uint32),
-                "depth": jnp.ones((F0,), jnp.int32),
-                "mask": out["valid"],
-            }
-        )
+        for start in range(0, F0, self._F_max):
+            queue.append(self._jit_take(padded0, jnp.int32(start), self._F_max))
         depth_cap = jnp.int32(self._depth_cap)
 
         while queue:
@@ -348,6 +365,8 @@ class TpuBfsChecker(Checker):
                     depth_cap,
                 )
                 table = wave["table"]
+                if self.warmup_seconds is None:
+                    self.warmup_seconds = time.perf_counter() - t_start
                 if attempt == 0:
                     self._state_count += int(wave["generated"])
                     self._max_depth = max(self._max_depth, int(wave["max_depth"]))
@@ -382,14 +401,10 @@ class TpuBfsChecker(Checker):
         )
 
     def _enqueue(self, queue, wave, n_new, B):
-        arrs = dict(wave["new"])
-        padded = self._jit_pad(arrs, _pow2ceil(B))
-        n_new_dev = jnp.int32(n_new)
+        target = -(-B // self._F_max) * self._F_max
+        padded = self._jit_finish(dict(wave["new"]), jnp.int32(n_new), target)
         for start in range(0, n_new, self._F_max):
-            size = _pow2ceil(min(self._F_max, n_new - start))
-            queue.append(
-                self._jit_take(padded, n_new_dev, jnp.int32(start), size)
-            )
+            queue.append(self._jit_take(padded, jnp.int32(start), self._F_max))
 
     def _visit_chunk(self, chunk):
         mask = np.asarray(chunk["mask"])

@@ -1,0 +1,12 @@
+"""Multi-device scale-out: fingerprint-sharded checking over a device mesh.
+
+The reference's parallelism is N worker threads around one concurrent map
+(``/root/reference/src/job_market.rs``); this package is its TPU-native
+replacement — ``jax.sharding.Mesh`` + ``shard_map`` with XLA collectives
+doing the frontier/visited-set exchange over ICI/DCN (SURVEY §2.8).
+"""
+
+from .base_mesh import AXIS, default_mesh
+from .sharded import ShardedTpuBfsChecker
+
+__all__ = ["AXIS", "ShardedTpuBfsChecker", "default_mesh"]

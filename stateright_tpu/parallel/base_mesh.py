@@ -1,0 +1,38 @@
+"""Mesh construction helpers for the sharded checkers.
+
+The canonical mesh has one axis, ``"fp"`` — devices own fingerprint ranges
+of the visited set. On real hardware this spans the TPU slice (and hosts,
+under ``jax.distributed``); in tests it is the virtual 8-device CPU mesh.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+AXIS = "fp"
+
+
+def _pow2floor(n: int) -> int:
+    return 1 << (n.bit_length() - 1)
+
+
+def default_mesh(n_devices: Optional[int] = None) -> Mesh:
+    """A 1-D ``"fp"`` mesh over the first ``n_devices`` devices.
+
+    Defaults to the largest power-of-two prefix of ``jax.devices()``
+    (collectives are fastest on power-of-two rings); any explicit count
+    works — the hash owner function is a modulo.
+    """
+    devices = jax.devices()
+    if n_devices is None:
+        n_devices = _pow2floor(len(devices))
+    if n_devices > len(devices):
+        raise ValueError(
+            f"requested {n_devices} devices, only {len(devices)} available"
+        )
+    return Mesh(np.array(devices[:n_devices]), (AXIS,))

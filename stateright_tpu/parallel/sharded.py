@@ -1,0 +1,642 @@
+"""Multi-device BFS: fingerprint-sharded visited set + all-to-all key routing.
+
+This is the scale-out design SURVEY §2.8 calls for: where the reference
+shares one concurrent ``DashMap`` between N worker threads
+(``/root/reference/src/checker/bfs.rs:28-29``, ``src/job_market.rs``), here
+every device in a ``jax.sharding.Mesh`` owns
+
+- a *shard of the visited hash set*, keyed by fingerprint range
+  (``owner = hi mod n_shards``), and
+- a *slice of the frontier*, which is purely data-parallel (any state may
+  live on any device — only the visited set is fingerprint-addressed).
+
+One wave, inside ``shard_map`` over mesh axis ``"fp"``:
+
+1. each device expands its local frontier slice (F_loc × A grid) and
+   fingerprints the candidates — pure local compute, MXU/VPU friendly;
+2. candidate *keys* (8 bytes each — never the packed states) are bucketed
+   by owner shard and exchanged with ``lax.all_to_all`` over ICI;
+3. each owner sort-dedups the keys it received, claim-inserts them into its
+   hash-set shard, and returns per-key fresh flags by the reverse
+   ``all_to_all``;
+4. senders compact their fresh candidates into the next local frontier
+   slice — new states never move off the device that generated them.
+
+The host loop only moves compacted *new-state* batches through a queue
+(the host↔device frontier scheduler replacing the reference's
+``JobBroker``) and ingests (child fp, parent fp) pairs for TLC-style path
+reconstruction, identical to the single-device ``TpuBfsChecker``.
+
+Multi-host note: the same program runs unchanged under ``jax.distributed``
+initialization — the mesh then spans hosts and the all-to-all rides
+ICI within a slice and DCN across slices; nothing here is host-count aware.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..core.batch import BatchableModel
+from ..core.model import Expectation
+from ..core.path import Path
+from ..ops.fingerprint import fingerprint_state, fp_to_int
+from ..ops.hashset import hashset_insert, hashset_new
+from .base_mesh import default_mesh
+from ..checker.base import Checker
+
+_DEPTH_INF = (1 << 31) - 1
+_U32_MAX = np.uint32(0xFFFFFFFF)
+_MAX_LOAD = 0.5
+
+
+def _pow2ceil(n: int) -> int:
+    return 1 << max(0, (n - 1).bit_length())
+
+
+def _sort_dedup(hi, lo, active):
+    """Sorts (hi, lo) keys, returns (shi, slo, sidx, unique_mask).
+
+    Inactive lanes sort to the end (key = U32_MAX pair) and are excluded
+    from ``unique_mask``.
+    """
+    m = hi.shape[0]
+    shi = jnp.where(active, hi, _U32_MAX)
+    slo = jnp.where(active, lo, _U32_MAX)
+    shi, slo, sidx = jax.lax.sort(
+        (shi, slo, jnp.arange(m, dtype=jnp.int32)), num_keys=2
+    )
+    uniq = jnp.concatenate(
+        [jnp.ones((1,), bool), (shi[1:] != shi[:-1]) | (slo[1:] != slo[:-1])]
+    )
+    return shi, slo, sidx, active[sidx] & uniq
+
+
+class ShardedTpuBfsChecker(Checker):
+    """BFS over a device mesh; requires a ``BatchableModel``.
+
+    ``frontier_per_device`` is the per-device frontier slice width (the
+    global chunk is ``n_devices ×`` that); ``table_capacity_per_device``
+    is each shard's initial hash-set size (grows by doubling + local
+    rehash — keys never change owner, so rehash needs no communication).
+    """
+
+    def __init__(
+        self,
+        options,
+        mesh: Optional[Mesh] = None,
+        frontier_per_device: int = 1 << 10,
+        table_capacity_per_device: int = 1 << 15,
+    ):
+        model = options.model
+        if not isinstance(model, BatchableModel):
+            raise TypeError(
+                f"spawn_sharded_tpu_bfs requires a BatchableModel; "
+                f"{type(model).__name__} does not implement the packed protocol"
+            )
+        self._mesh = mesh if mesh is not None else default_mesh()
+        n = self._mesh.devices.size
+        self._n = n
+        self._model = model
+        self._properties = model.properties()
+        self._conditions = model.packed_conditions()
+        if len(self._conditions) != len(self._properties):
+            raise ValueError(
+                "packed_conditions() must align 1:1 with properties(): "
+                f"{len(self._conditions)} != {len(self._properties)}"
+            )
+        eventually = [
+            i
+            for i, p in enumerate(self._properties)
+            if p.expectation == Expectation.EVENTUALLY
+        ]
+        if len(eventually) > 32:
+            raise ValueError("at most 32 eventually properties supported")
+        self._ebit: Dict[int, int] = {pi: b for b, pi in enumerate(eventually)}
+        self._ebits0 = sum(1 << b for b in self._ebit.values())
+        self._A = model.packed_action_count()
+        self._F_loc = _pow2ceil(frontier_per_device)
+        self._G = n * self._F_loc  # global frontier chunk width
+        # Probing masks with (capacity - 1): non-pow2 would address only a
+        # subset of rows.
+        self._cap_loc = _pow2ceil(table_capacity_per_device)
+        self._visitor = options._visitor
+        self._target_state_count: Optional[int] = options._target_state_count
+        self._depth_cap = options._target_max_depth or _DEPTH_INF
+
+        self._state_count = 0
+        self._unique_count = 0
+        self._max_depth = 0
+        self._discoveries_fp: Dict[str, int] = {}
+        self._wave_log: List = []
+        self._parent_map: Dict[int, Optional[int]] = {}
+        self._ingested = 0
+        self._ingest_lock = threading.Lock()
+        self._done_event = threading.Event()
+        self._error: Optional[BaseException] = None
+
+        self._shard = NamedSharding(self._mesh, P("fp"))
+        self._replicated = NamedSharding(self._mesh, P())
+        self._jit_wave = jax.jit(
+            shard_map(
+                self._wave_local,
+                mesh=self._mesh,
+                in_specs=(P("fp"),) * 7 + (P(),),
+                out_specs=P("fp"),
+                check_vma=False,
+            )
+        )
+        self._jit_insert = jax.jit(
+            shard_map(
+                self._insert_local,
+                mesh=self._mesh,
+                in_specs=(P("fp"),) * 4,
+                out_specs=P("fp"),
+                check_vma=False,
+            )
+        )
+        self._jit_rehash = jax.jit(
+            shard_map(
+                self._rehash_local,
+                mesh=self._mesh,
+                in_specs=(P("fp"), P("fp")),
+                out_specs=P("fp"),
+                check_vma=False,
+            )
+        )
+        self._jit_fp_batch = jax.jit(jax.vmap(fingerprint_state))
+        self._jit_fp_single = jax.jit(fingerprint_state)
+
+        self._handles = [
+            threading.Thread(target=self._run, name="sharded-tpu-bfs", daemon=True)
+        ]
+        self._handles[0].start()
+
+    # -- per-device kernels (inside shard_map) ----------------------------
+
+    def _route_insert(self, table_loc, hi, lo, valid):
+        """Key exchange + sharded claim-insert; returns (table, fresh, overflow).
+
+        ``hi/lo/valid`` are this device's local candidate keys (m lanes).
+        ``fresh`` marks, per local lane, that *this* lane's key claimed a
+        brand-new slot somewhere in the global set. Exactly one lane wins
+        per distinct key across the whole mesh.
+        """
+        n = self._n
+        m = hi.shape[0]
+        owner = (hi % jnp.uint32(n)).astype(jnp.int32)
+
+        send_hi = jnp.zeros((n, m), jnp.uint32)
+        send_lo = jnp.zeros((n, m), jnp.uint32)
+        src_slot = jnp.full((n, m), m, jnp.int32)
+        lanes = jnp.arange(m, dtype=jnp.int32)
+        for o in range(n):
+            sel = valid & (owner == o)
+            pos = jnp.cumsum(sel.astype(jnp.int32)) - 1
+            slot = jnp.where(sel, pos, m)
+            send_hi = send_hi.at[o, slot].set(hi, mode="drop")
+            send_lo = send_lo.at[o, slot].set(lo, mode="drop")
+            src_slot = src_slot.at[o, slot].set(lanes, mode="drop")
+
+        recv_hi = jax.lax.all_to_all(
+            send_hi, "fp", split_axis=0, concat_axis=0, tiled=True
+        )
+        recv_lo = jax.lax.all_to_all(
+            send_lo, "fp", split_axis=0, concat_axis=0, tiled=True
+        )
+
+        rhi = recv_hi.reshape(n * m)
+        rlo = recv_lo.reshape(n * m)
+        # (0, 0) is the bucket padding sentinel; fingerprints are never (0,0).
+        ractive = (rhi != 0) | (rlo != 0)
+        shi, slo, sidx, uniq = _sort_dedup(rhi, rlo, ractive)
+        table_loc, fresh_s, _found, pending = hashset_insert(
+            table_loc, shi, slo, uniq
+        )
+        overflow = pending.sum()
+        # Un-sort fresh flags back to received order, then reverse-exchange.
+        fresh_r = (
+            jnp.zeros((n * m,), bool).at[sidx].set(fresh_s).reshape(n, m)
+        )
+        fresh_back = jax.lax.all_to_all(
+            fresh_r, "fp", split_axis=0, concat_axis=0, tiled=True
+        )
+        fresh = (
+            jnp.zeros((m,), bool)
+            .at[src_slot.reshape(-1)]
+            .set(fresh_back.reshape(-1), mode="drop")
+        )
+        return table_loc, fresh, overflow
+
+    def _insert_local(self, table, hi, lo, valid):
+        """Standalone sharded insert (used to seed the initial states)."""
+        table_loc, fresh, overflow = self._route_insert(
+            table[0], hi, lo, valid
+        )
+        return {
+            "table": table_loc[None],
+            "fresh": fresh,
+            "overflow": overflow[None],
+        }
+
+    def _wave_local(self, table, states, hi, lo, ebits, depth, mask, depth_cap):
+        model = self._model
+        A = self._A
+        F = hi.shape[0]  # local slice width
+        B = F * A
+        table_loc = table[0]
+        eval_mask = mask & (depth < depth_cap)
+
+        cond_vals = [jax.vmap(c)(states) for c in self._conditions]
+        ebits_after = ebits
+        for pi, b in self._ebit.items():
+            ebits_after = jnp.where(
+                cond_vals[pi], ebits_after & ~jnp.uint32(1 << b), ebits_after
+            )
+
+        aids = jnp.arange(A, dtype=jnp.int32)
+        cand, cvalid = jax.vmap(
+            lambda s: jax.vmap(lambda a: model.packed_step(s, a))(aids)
+        )(states)
+        cvalid = cvalid & eval_mask[:, None]
+        cvalid = cvalid & jax.vmap(jax.vmap(model.packed_within_boundary))(cand)
+        generated = cvalid.sum(dtype=jnp.int32)
+        terminal = eval_mask & ~cvalid.any(axis=1)
+
+        cand_flat = jax.tree_util.tree_map(
+            lambda x: x.reshape((B,) + x.shape[2:]), cand
+        )
+        cvalid_flat = cvalid.reshape(B)
+        chi, clo = jax.vmap(fingerprint_state)(cand_flat)
+
+        # Local pre-dedup: only one lane per distinct key is routed, so the
+        # owner-side exchange carries no intra-device duplicates.
+        _shi, _slo, sidx, uniq = _sort_dedup(chi, clo, cvalid_flat)
+        route = jnp.zeros((B,), bool).at[sidx].set(uniq)
+        table_loc, fresh, overflow = self._route_insert(
+            table_loc, chi, clo, route
+        )
+
+        # Compact fresh candidates into the local next-frontier slots.
+        pos = jnp.cumsum(fresh.astype(jnp.int32)) - 1
+        out_slot = jnp.where(fresh, pos, B)
+        zi = jnp.zeros((B,), jnp.int32)
+        zu = jnp.zeros((B,), jnp.uint32)
+        src_idx = zi.at[out_slot].set(
+            jnp.arange(B, dtype=jnp.int32), mode="drop"
+        )
+        parent_row = src_idx // A
+        new_states = jax.tree_util.tree_map(
+            lambda x: x[src_idx], cand_flat
+        )
+        out = {
+            "table": table_loc[None],
+            "generated": generated[None],
+            "n_new": fresh.sum(dtype=jnp.int32)[None],
+            "overflow": overflow[None],
+            "max_depth": jnp.max(jnp.where(mask, depth, 0))[None],
+            "new_states": new_states,
+            "new_hi": zu.at[out_slot].set(chi, mode="drop"),
+            "new_lo": zu.at[out_slot].set(clo, mode="drop"),
+            "new_ebits": ebits_after[parent_row]
+            * (jnp.arange(B) < fresh.sum()),
+            "new_depth": (depth[parent_row] + 1)
+            * (jnp.arange(B) < fresh.sum()),
+            "parent_hi": hi[parent_row] * (jnp.arange(B) < fresh.sum()),
+            "parent_lo": lo[parent_row] * (jnp.arange(B) < fresh.sum()),
+        }
+
+        hits, fhis, flos = [], [], []
+        for i, p in enumerate(self._properties):
+            if p.expectation == Expectation.ALWAYS:
+                h = eval_mask & ~cond_vals[i]
+            elif p.expectation == Expectation.SOMETIMES:
+                h = eval_mask & cond_vals[i]
+            else:
+                b = self._ebit[i]
+                h = terminal & (((ebits_after >> jnp.uint32(b)) & 1) == 1)
+            idx = jnp.argmax(h)
+            hits.append(h.any())
+            fhis.append(hi[idx])
+            flos.append(lo[idx])
+        if self._properties:
+            out["prop_hit"] = jnp.stack(hits)[None]
+            out["prop_hi"] = jnp.stack(fhis)[None]
+            out["prop_lo"] = jnp.stack(flos)[None]
+        return out
+
+    def _rehash_local(self, old_table, new_table):
+        old = old_table[0]
+        new = new_table[0]
+        active = (old[:, 0] != 0) | (old[:, 1] != 0)
+        new, _fresh, _found, pending = hashset_insert(
+            new, old[:, 0], old[:, 1], active
+        )
+        return {"table": new[None], "overflow": pending.sum()[None]}
+
+    # -- host side ---------------------------------------------------------
+
+    def _run(self):
+        try:
+            self._explore()
+        except BaseException as e:  # noqa: BLE001 - surfaced via worker_error
+            self._error = e
+        finally:
+            self._done_event.set()
+
+    def _new_table(self):
+        # Allocate pre-sharded: materializing the global table on one device
+        # first would OOM exactly when shards are sized near per-device HBM.
+        return jax.jit(
+            lambda: jnp.zeros((self._n, self._cap_loc, 2), jnp.uint32),
+            out_shardings=self._shard,
+        )()
+
+    def _grow_table(self, table, min_cap_loc):
+        while self._cap_loc < min_cap_loc:
+            self._cap_loc *= 2
+        out = self._jit_rehash(table, self._new_table())
+        if int(np.asarray(out["overflow"]).sum()):
+            raise RuntimeError("sharded rehash overflowed probe cap")
+        return out["table"]
+
+    def _put_chunk(self, arrs):
+        return jax.tree_util.tree_map(
+            lambda x: jax.device_put(jnp.asarray(x), self._shard), arrs
+        )
+
+    # The host pool is a deque of harvested row-batches; only the rows that
+    # feed the next chunk are ever copied (a single running array would cost
+    # O(frontier²/G) re-concatenation on big frontiers).
+
+    @staticmethod
+    def _rows_slice(batch, lo, hi):
+        return {
+            k: (
+                jax.tree_util.tree_map(lambda x: x[lo:hi], v)
+                if k == "states"
+                else v[lo:hi]
+            )
+            for k, v in batch.items()
+        }
+
+    def _pool_append(self, rows):
+        n = rows["hi"].shape[0]
+        if n:
+            self._pool.append(rows)
+            self._pool_count += n
+
+    def _pool_take(self, width):
+        """Pops up to ``width`` rows, padding to exactly ``width``."""
+        parts = []
+        got = 0
+        while got < width and self._pool:
+            batch = self._pool.popleft()
+            n = batch["hi"].shape[0]
+            if got + n > width:
+                keep = width - got
+                self._pool.appendleft(self._rows_slice(batch, keep, n))
+                batch = self._rows_slice(batch, 0, keep)
+                n = keep
+            parts.append(batch)
+            got += n
+        self._pool_count -= got
+
+        def cat_pad(*xs):
+            out = np.concatenate(xs) if len(xs) > 1 else np.asarray(xs[0])
+            if out.shape[0] < width:
+                pad = [(0, width - out.shape[0])] + [(0, 0)] * (out.ndim - 1)
+                out = np.pad(out, pad)
+            return out
+
+        chunk = {
+            k: (
+                jax.tree_util.tree_map(cat_pad, *(p[k] for p in parts))
+                if k == "states"
+                else cat_pad(*(p[k] for p in parts))
+            )
+            for k in parts[0]
+        }
+        chunk["mask"] = np.arange(width) < got
+        return chunk
+
+    def _explore(self):
+        props = self._properties
+        n, G, A = self._n, self._G, self._A
+        model = self._model
+
+        # Seed: fingerprint + dedup-insert the initial states.
+        init = model.packed_init_states()
+        n0 = jax.tree_util.tree_leaves(init)[0].shape[0]
+        width = max(G, n * _pow2ceil((n0 + n - 1) // n))
+
+        def pad0(x):
+            return np.pad(
+                np.asarray(x), [(0, width - n0)] + [(0, 0)] * (x.ndim - 1)
+            )
+
+        init_np = jax.tree_util.tree_map(pad0, init)
+        hi, lo = (np.asarray(a) for a in self._jit_fp_batch(init_np))
+        in_range = np.arange(width) < n0
+        bound = np.asarray(
+            jax.jit(jax.vmap(model.packed_within_boundary))(init_np)
+        )
+        valid = in_range & bound
+
+        table = self._new_table()
+        while True:
+            out = self._jit_insert(
+                table,
+                *(
+                    jax.device_put(jnp.asarray(a), self._shard)
+                    for a in (hi, lo, valid)
+                ),
+            )
+            if not int(np.asarray(out["overflow"]).sum()):
+                break
+            self._cap_loc *= 2
+            table = self._new_table()
+        table = out["table"]
+        fresh = np.asarray(out["fresh"])
+        self._state_count = int(valid.sum())
+        self._unique_count = int(fresh.sum())
+        child64 = (hi.astype(np.uint64) << np.uint64(32)) | lo.astype(np.uint64)
+        self._wave_log.append((child64[fresh], np.zeros((fresh.sum(),), np.uint64)))
+
+        self._pool = deque()
+        self._pool_count = 0
+        self._pool_append(
+            {
+                "states": jax.tree_util.tree_map(lambda x: x[fresh], init_np),
+                "hi": hi[fresh],
+                "lo": lo[fresh],
+                "ebits": np.full((int(fresh.sum()),), self._ebits0, np.uint32),
+                "depth": np.ones((int(fresh.sum()),), np.int32),
+            }
+        )
+        depth_cap = jnp.int32(self._depth_cap)
+
+        while self._pool_count:
+            if not props:
+                break
+            if len(self._discoveries_fp) == len(props):
+                break
+            if (
+                self._target_state_count is not None
+                and self._target_state_count <= self._state_count
+            ):
+                break
+            B_glob = G * A
+            if (self._unique_count + B_glob) > _MAX_LOAD * n * self._cap_loc:
+                table = self._grow_table(
+                    table,
+                    _pow2ceil(
+                        int((self._unique_count + B_glob) / (_MAX_LOAD * n))
+                    ),
+                )
+            chunk = self._pool_take(G)
+            dev = self._put_chunk(chunk)
+
+            attempt = 0
+            while True:
+                wave = self._jit_wave(
+                    table,
+                    dev["states"],
+                    dev["hi"],
+                    dev["lo"],
+                    dev["ebits"],
+                    dev["depth"],
+                    dev["mask"],
+                    depth_cap,
+                )
+                table = wave["table"]
+                if attempt == 0:
+                    self._state_count += int(np.asarray(wave["generated"]).sum())
+                    self._max_depth = max(
+                        self._max_depth, int(np.asarray(wave["max_depth"]).max())
+                    )
+                    if props:
+                        hit = np.asarray(wave["prop_hit"])
+                        phi = np.asarray(wave["prop_hi"])
+                        plo = np.asarray(wave["prop_lo"])
+                        for i, p in enumerate(props):
+                            if p.name in self._discoveries_fp:
+                                continue
+                            for d in range(n):
+                                if hit[d, i]:
+                                    self._discoveries_fp[p.name] = fp_to_int(
+                                        phi[d, i], plo[d, i]
+                                    )
+                                    break
+                    if self._visitor is not None:
+                        self._visit_chunk(chunk)
+                self._harvest(wave)
+                if not int(np.asarray(wave["overflow"]).sum()):
+                    break
+                table = self._grow_table(table, self._cap_loc * 2)
+                attempt += 1
+            # Re-ingest fresh rows for the next chunks.
+            del dev
+
+    def _harvest(self, wave):
+        """Pulls each device's compacted fresh rows into the host pool."""
+        n_new = np.asarray(wave["n_new"])
+        total = int(n_new.sum())
+        self._unique_count += total
+        if not total:
+            return
+        B = self._G * self._A // self._n
+        hi = np.asarray(wave["new_hi"])
+        lo = np.asarray(wave["new_lo"])
+        ebits = np.asarray(wave["new_ebits"])
+        depth = np.asarray(wave["new_depth"])
+        phi = np.asarray(wave["parent_hi"])
+        plo = np.asarray(wave["parent_lo"])
+        states = jax.tree_util.tree_map(np.asarray, wave["new_states"])
+        sel = np.zeros((self._n * B,), bool)
+        for d in range(self._n):
+            sel[d * B : d * B + int(n_new[d])] = True
+        child64 = (hi.astype(np.uint64) << np.uint64(32)) | lo.astype(np.uint64)
+        par64 = (phi.astype(np.uint64) << np.uint64(32)) | plo.astype(np.uint64)
+        self._wave_log.append((child64[sel], par64[sel]))
+        self._pool_append(
+            {
+                "states": jax.tree_util.tree_map(lambda x: x[sel], states),
+                "hi": hi[sel],
+                "lo": lo[sel],
+                "ebits": ebits[sel].astype(np.uint32),
+                "depth": depth[sel].astype(np.int32),
+            }
+        )
+
+    def _visit_chunk(self, chunk):
+        mask = np.asarray(chunk["mask"])
+        depth = np.asarray(chunk["depth"])
+        hi = np.asarray(chunk["hi"])
+        lo = np.asarray(chunk["lo"])
+        for i in range(len(mask)):
+            if mask[i] and depth[i] < self._depth_cap:
+                self._visitor.visit(
+                    self._model, self._reconstruct(fp_to_int(hi[i], lo[i]))
+                )
+
+    # -- path reconstruction ----------------------------------------------
+
+    def _host_fp(self, host_state) -> int:
+        hi, lo = self._jit_fp_single(self._model.pack_state(host_state))
+        return fp_to_int(hi, lo)
+
+    def _ingest_wave_log(self):
+        with self._ingest_lock:
+            while self._ingested < len(self._wave_log):
+                children, parents = self._wave_log[self._ingested]
+                for c, p in zip(children.tolist(), parents.tolist()):
+                    if c not in self._parent_map:
+                        self._parent_map[c] = p if p else None
+                self._ingested += 1
+
+    def _reconstruct(self, fp: int) -> Path:
+        self._ingest_wave_log()
+        chain: deque = deque()
+        cur: Optional[int] = fp
+        while cur is not None:
+            chain.appendleft(cur)
+            cur = self._parent_map.get(cur)
+        return Path.from_fingerprints(self._model, chain, fp_of=self._host_fp)
+
+    # -- Checker surface ---------------------------------------------------
+
+    def model(self):
+        return self._model
+
+    def state_count(self) -> int:
+        return max(self._state_count, self._unique_count)
+
+    def unique_state_count(self) -> int:
+        return self._unique_count
+
+    def max_depth(self) -> int:
+        return self._max_depth
+
+    def discoveries(self) -> Dict[str, Path]:
+        return {
+            name: self._reconstruct(fp)
+            for name, fp in list(self._discoveries_fp.items())
+        }
+
+    def handles(self) -> List[threading.Thread]:
+        handles, self._handles = self._handles, []
+        return handles
+
+    def is_done(self) -> bool:
+        return self._done_event.is_set()
+
+    def worker_error(self) -> Optional[BaseException]:
+        return self._error

@@ -1,0 +1,92 @@
+"""Parity tests: sharded multi-device BFS == host checker, exact counts.
+
+Runs on the virtual 8-device CPU mesh (see conftest). The oracle counts are
+the reference's own (288 / 8,832 for 2pc — ``/root/reference/examples/2pc.rs:153-159``).
+"""
+
+import jax
+import pytest
+
+from stateright_tpu.models.two_phase_commit import TwoPhaseSys
+from stateright_tpu.parallel import default_mesh
+
+
+def test_mesh_has_8_devices():
+    assert len(jax.devices()) == 8
+    assert default_mesh().devices.size == 8
+
+
+def test_sharded_2pc_3rms_matches_oracle():
+    checker = (
+        TwoPhaseSys(3)
+        .checker()
+        .spawn_sharded_tpu_bfs(frontier_per_device=64, table_capacity_per_device=256)
+        .join()
+    )
+    assert checker.worker_error() is None
+    assert checker.unique_state_count() == 288
+    checker.assert_properties()
+
+
+def test_sharded_2pc_5rms_matches_oracle():
+    checker = (
+        TwoPhaseSys(5)
+        .checker()
+        .spawn_sharded_tpu_bfs(frontier_per_device=256, table_capacity_per_device=512)
+        .join()
+    )
+    assert checker.worker_error() is None
+    assert checker.unique_state_count() == 8832
+    checker.assert_properties()
+
+
+def test_sharded_matches_host_bfs_counts():
+    host = TwoPhaseSys(4).checker().spawn_bfs().join()
+    dev = TwoPhaseSys(4).checker().spawn_sharded_tpu_bfs(
+        frontier_per_device=128, table_capacity_per_device=512
+    ).join()
+    assert dev.worker_error() is None
+    assert dev.unique_state_count() == host.unique_state_count()
+
+
+def test_sharded_discovery_paths_replay():
+    checker = (
+        TwoPhaseSys(3)
+        .checker()
+        .spawn_sharded_tpu_bfs(frontier_per_device=64)
+        .join()
+    )
+    assert checker.worker_error() is None
+    paths = checker.discoveries()
+    assert set(paths) == {"abort agreement", "commit agreement"}
+    for path in paths.values():
+        # Paths replay through the host model (nondeterminism discipline).
+        assert len(path) >= 1
+
+
+def test_sharded_target_max_depth():
+    full = TwoPhaseSys(3).checker().spawn_bfs().join()
+    capped = (
+        TwoPhaseSys(3)
+        .checker()
+        .target_max_depth(3)
+        .spawn_sharded_tpu_bfs(frontier_per_device=64)
+        .join()
+    )
+    assert capped.worker_error() is None
+    assert capped.max_depth() <= 3
+    assert capped.unique_state_count() < full.unique_state_count()
+
+
+@pytest.mark.parametrize("n_dev", [1, 2, 4])
+def test_sharded_submesh_sizes(n_dev):
+    checker = (
+        TwoPhaseSys(3)
+        .checker()
+        .spawn_sharded_tpu_bfs(
+            mesh=default_mesh(n_dev), frontier_per_device=64
+        )
+        .join()
+    )
+    assert checker.worker_error() is None
+    assert checker.unique_state_count() == 288
