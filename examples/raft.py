@@ -1,0 +1,67 @@
+#!/usr/bin/env python
+"""Raft leader election example CLI (new; BASELINE config — the reference has
+no Raft example). 5 servers, lossy network, term-bounded."""
+
+import sys
+
+from _cli import (
+    network_names,
+    opt_int,
+    opt_network,
+    opt_str,
+    parse_args,
+    report,
+    thread_count,
+)
+
+from stateright_tpu.models.raft import RaftModelCfg
+
+
+def _cfg(server_count, max_term, network):
+    kwargs = dict(server_count=server_count, max_term=max_term, lossy=True)
+    if network is not None:
+        kwargs["network"] = network
+    return RaftModelCfg(**kwargs)
+
+
+def main(argv=sys.argv):
+    cmd, free = parse_args(argv)
+    if cmd in ("check", "check-sym"):
+        server_count = opt_int(free, 0, 5)
+        max_term = opt_int(free, 1, 2)
+        network = opt_network(free, 2)
+        sym = " with symmetry reduction" if cmd == "check-sym" else ""
+        print(
+            f"Model checking Raft leader election with {server_count} servers"
+            f" (max term {max_term}){sym}."
+        )
+        builder = (
+            _cfg(server_count, max_term, network)
+            .into_model()
+            .checker()
+            .threads(thread_count())
+        )
+        if cmd == "check-sym":
+            builder = builder.symmetry()
+        report(builder.spawn_dfs())
+    elif cmd == "explore":
+        server_count = opt_int(free, 0, 3)
+        address = opt_str(free, 1, "localhost:3000")
+        network = opt_network(free, 2)
+        print(
+            f"Exploring state space for Raft with {server_count} servers "
+            f"on {address}."
+        )
+        _cfg(server_count, 1, network).into_model().checker().threads(
+            thread_count()
+        ).serve(address)
+    else:
+        print("USAGE:")
+        print("  ./raft.py check [SERVER_COUNT] [MAX_TERM] [NETWORK]")
+        print("  ./raft.py check-sym [SERVER_COUNT] [MAX_TERM] [NETWORK]")
+        print("  ./raft.py explore [SERVER_COUNT] [ADDRESS] [NETWORK]")
+        print(f"NETWORK: {network_names()}")
+
+
+if __name__ == "__main__":
+    main()

@@ -166,7 +166,14 @@ class BfsChecker(Checker):
                     pending.appendleft((next_state, next_fp, ebits, depth + 1))
                 if is_terminal:
                     for i, prop in enumerate(properties):
-                        if i in ebits:
+                        # Insert-if-vacant: once a property has a discovery its
+                        # ebit is no longer cleared during evaluation, so a
+                        # stale set bit here must not overwrite the valid
+                        # counterexample with a path that never tracked it
+                        # (deviation: the reference overwrites, which can
+                        # report an "eventually" trace ending in a state that
+                        # satisfies the property; counts are unaffected).
+                        if i in ebits and prop.name not in discoveries:
                             discoveries[prop.name] = state_fp
         finally:
             with self._count_lock:

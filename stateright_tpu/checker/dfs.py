@@ -177,7 +177,11 @@ class DfsChecker(Checker):
                     )
                 if is_terminal:
                     for i, prop in enumerate(properties):
-                        if i in ebits:
+                        # Insert-if-vacant: a stale ebit (clearing stops once
+                        # the property is discovered) must not overwrite the
+                        # valid counterexample — see the matching note in
+                        # bfs.py; counts are unaffected.
+                        if i in ebits and prop.name not in discoveries:
                             discoveries[prop.name] = list(fingerprints)
         finally:
             with self._count_lock:

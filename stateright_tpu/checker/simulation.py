@@ -189,7 +189,8 @@ class SimulationChecker(Checker):
                 break  # terminal: still check eventually properties below
 
         for i, prop in enumerate(properties):
-            if i in ebits:
+            # Insert-if-vacant — see the matching note in bfs.py.
+            if i in ebits and prop.name not in discoveries:
                 discoveries[prop.name] = list(fingerprint_path)
 
     # -- Checker surface ---------------------------------------------------

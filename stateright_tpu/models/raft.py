@@ -1,0 +1,203 @@
+"""Raft leader election, model-checked with lossy networks and symmetry.
+
+A new example required by the BASELINE configs (the reference ships no Raft
+example; the actor/builder idioms follow ``/root/reference/examples/paxos.rs``).
+Scope is the election subprotocol: election timers fire nondeterministically
+(every timing interleaving is explored), candidates solicit votes, a majority
+quorum elects a leader which announces itself by heartbeat.
+
+Checked properties:
+
+- ``always "election safety"`` — at most one leader per term (Raft paper §5.2
+  invariant); holds under message loss, duplication, and reordering.
+- ``sometimes "leader elected"`` — a leader exists (witness the protocol can
+  make progress).
+- ``eventually "stable leader"`` — *intentionally falsifiable*: repeated
+  split votes (or total message loss on lossy networks) can exhaust the term
+  boundary with no leader elected, and the checker reports the
+  counterexample trace; liveness in Raft requires randomized timeouts, which
+  a model checker deliberately explores the adversarial schedules of.
+
+The term bound (``max_term``) is the state-space boundary knob, like the
+reference's ``max_nat`` ping-pong bound.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import FrozenSet, List, Optional
+
+from ..actor import (
+    Actor,
+    ActorModel,
+    Id,
+    Network,
+    Out,
+    model_peers,
+    model_timeout,
+)
+from ..core.model import Expectation
+
+FOLLOWER, CANDIDATE, LEADER = "Follower", "Candidate", "Leader"
+ELECTION = "Election"
+
+
+def majority(cluster_size: int) -> int:
+    return cluster_size // 2 + 1
+
+
+# Messages (no embedded Ids — src carries the sender, keeping symmetry
+# rewriting to the envelope level):
+#   ("RequestVote", term)
+#   ("Vote", term)            -- a granted vote (denials are silent)
+#   ("Heartbeat", term)
+
+
+@dataclass(frozen=True)
+class RaftState:
+    role: str
+    term: int
+    voted_for: Optional[Id]
+    votes: FrozenSet[Id]
+
+
+class RaftActor(Actor):
+    def __init__(self, peer_ids: List[Id]):
+        self.peer_ids = peer_ids
+
+    def name(self) -> str:
+        return "Raft Server"
+
+    def _cluster_size(self) -> int:
+        return len(self.peer_ids) + 1
+
+    def on_start(self, id: Id, o: Out) -> RaftState:
+        o.set_timer(ELECTION, model_timeout())
+        return RaftState(role=FOLLOWER, term=0, voted_for=None, votes=frozenset())
+
+    def on_timeout(self, id: Id, state: RaftState, timer, o: Out):
+        if timer != ELECTION:
+            return None
+        # Start (or restart, on split votes) an election.
+        o.set_timer(ELECTION, model_timeout())
+        term = state.term + 1
+        votes = frozenset([id])
+        if len(votes) >= majority(self._cluster_size()):
+            # Single-node cluster: the self-vote is already a majority.
+            o.cancel_timer(ELECTION)
+            return RaftState(role=LEADER, term=term, voted_for=id, votes=votes)
+        o.broadcast(self.peer_ids, ("RequestVote", term))
+        return RaftState(role=CANDIDATE, term=term, voted_for=id, votes=votes)
+
+    def on_msg(self, id: Id, state: RaftState, src: Id, msg, o: Out):
+        kind, term = msg[0], msg[1]
+        if kind == "RequestVote":
+            if term > state.term:
+                # Newer term: adopt it as a follower and grant the vote.
+                o.send(src, ("Vote", term))
+                return RaftState(
+                    role=FOLLOWER, term=term, voted_for=src, votes=frozenset()
+                )
+            if (
+                term == state.term
+                and state.role == FOLLOWER
+                and state.voted_for in (None, src)
+            ):
+                o.send(src, ("Vote", term))
+                if state.voted_for == src:
+                    return None  # duplicate request, vote resent
+                return RaftState(
+                    role=FOLLOWER,
+                    term=term,
+                    voted_for=src,
+                    votes=state.votes,
+                )
+            return None  # stale term or vote already cast: deny silently
+
+        if kind == "Vote":
+            if state.role != CANDIDATE or term != state.term:
+                return None  # stale vote (e.g. from a previous election)
+            votes = state.votes | {src}
+            if len(votes) >= majority(self._cluster_size()):
+                o.cancel_timer(ELECTION)
+                o.broadcast(self.peer_ids, ("Heartbeat", state.term))
+                return RaftState(
+                    role=LEADER,
+                    term=state.term,
+                    voted_for=state.voted_for,
+                    votes=votes,
+                )
+            if votes == state.votes:
+                return None  # duplicate vote
+            return RaftState(
+                role=CANDIDATE,
+                term=state.term,
+                voted_for=state.voted_for,
+                votes=votes,
+            )
+
+        if kind == "Heartbeat":
+            if term < state.term:
+                return None  # stale leader
+            if state.role == FOLLOWER and term == state.term:
+                # Already following this term's leader; renewing the election
+                # timer alone would be a no-op-with-timer (pruned).
+                o.set_timer(ELECTION, model_timeout())
+                return None
+            o.set_timer(ELECTION, model_timeout())
+            return RaftState(
+                role=FOLLOWER,
+                term=term,
+                voted_for=state.voted_for if term == state.term else None,
+                votes=frozenset(),
+            )
+
+        return None
+
+
+@dataclass
+class RaftModelCfg:
+    server_count: int = 5
+    max_term: int = 2
+    lossy: bool = True
+    max_crashes: int = 0
+    network: Network = field(
+        default_factory=Network.new_unordered_nonduplicating
+    )
+
+    def into_model(self) -> ActorModel:
+        model = ActorModel(cfg=self, init_history=None)
+        for i in range(self.server_count):
+            model.actor(RaftActor(model_peers(i, self.server_count)))
+
+        def election_safety(_model, state):
+            leaders = [
+                s.term
+                for s, crashed in zip(state.actor_states, state.crashed)
+                if not crashed and s.role == LEADER
+            ]
+            return len(leaders) == len(set(leaders))
+
+        def leader_elected(_model, state):
+            # Crashed leaders don't count (consistent with election_safety):
+            # a dead leader's cluster is leaderless.
+            return any(
+                s.role == LEADER
+                for s, crashed in zip(state.actor_states, state.crashed)
+                if not crashed
+            )
+
+        max_term = self.max_term
+        return (
+            model.init_network(self.network)
+            .lossy_network(self.lossy)
+            .max_crashes(self.max_crashes)
+            .within_boundary_fn(
+                lambda _cfg, state: all(
+                    s.term <= max_term for s in state.actor_states
+                )
+            )
+            .property(Expectation.ALWAYS, "election safety", election_safety)
+            .property(Expectation.SOMETIMES, "leader elected", leader_elected)
+            .property(Expectation.EVENTUALLY, "stable leader", leader_elected)
+        )

@@ -1,0 +1,148 @@
+"""Raft leader election: exact-count oracles + property assertions.
+
+The reference ships no Raft, so these counts are this framework's own
+regression oracles (first measured from the host BFS checker, then pinned —
+the same technique the reference uses for its examples, e.g.
+``/root/reference/examples/2pc.rs:151-170``).
+"""
+
+import pytest
+
+from stateright_tpu.actor import Network
+from stateright_tpu.core.model import Expectation
+from stateright_tpu.models.raft import LEADER, RaftModelCfg
+
+
+def test_lossless_duplicating_counts():
+    c = (
+        RaftModelCfg(
+            server_count=3,
+            max_term=1,
+            lossy=False,
+            network=Network.new_unordered_duplicating(),
+        )
+        .into_model()
+        .checker()
+        .spawn_bfs()
+        .join()
+    )
+    assert c.unique_state_count() == 53
+    assert c.max_depth() == 6
+
+
+def test_lossy_duplicating_counts():
+    c = (
+        RaftModelCfg(
+            server_count=3,
+            max_term=1,
+            lossy=True,
+            network=Network.new_unordered_duplicating(),
+        )
+        .into_model()
+        .checker()
+        .spawn_bfs()
+        .join()
+    )
+    assert c.unique_state_count() == 2717
+
+
+def test_lossy_nonduplicating_counts():
+    c = (
+        RaftModelCfg(server_count=3, max_term=1, lossy=True)
+        .into_model()
+        .checker()
+        .spawn_bfs()
+        .join()
+    )
+    assert c.unique_state_count() == 665
+
+
+def test_ordered_lossless_counts():
+    c = (
+        RaftModelCfg(
+            server_count=3, max_term=1, lossy=False, network=Network.new_ordered()
+        )
+        .into_model()
+        .checker()
+        .spawn_bfs()
+        .join()
+    )
+    assert c.unique_state_count() == 341
+
+
+def test_election_safety_holds_and_liveness_fails():
+    c = (
+        RaftModelCfg(server_count=3, max_term=1, lossy=True)
+        .into_model()
+        .checker()
+        .spawn_bfs()
+        .join()
+    )
+    paths = c.discoveries()
+    # Safety: no counterexample for "election safety".
+    assert "election safety" not in paths
+    # A leader is reachable…
+    leader_path = paths["leader elected"]
+    assert any(s.role == LEADER for s in leader_path.last_state().actor_states)
+    # …but not guaranteed: adversarial schedules (message loss / split votes)
+    # exhaust the term boundary leaderless, so "stable leader" yields an
+    # eventually-counterexample whose final state has no leader.
+    stuck = paths["stable leader"].last_state()
+    assert not any(s.role == LEADER for s in stuck.actor_states)
+
+
+def test_symmetry_reduction_shrinks_space_preserving_discoveries():
+    full = (
+        RaftModelCfg(
+            server_count=3,
+            max_term=1,
+            lossy=True,
+            network=Network.new_unordered_duplicating(),
+        )
+        .into_model()
+        .checker()
+        .spawn_dfs()
+        .join()
+    )
+    reduced = (
+        RaftModelCfg(
+            server_count=3,
+            max_term=1,
+            lossy=True,
+            network=Network.new_unordered_duplicating(),
+        )
+        .into_model()
+        .checker()
+        .symmetry()
+        .spawn_dfs()
+        .join()
+    )
+    assert full.unique_state_count() == 2717
+    assert reduced.unique_state_count() == 621
+    assert set(reduced.discoveries()) == {"leader elected", "stable leader"}
+
+
+def test_single_node_cluster_elects_itself():
+    c = (
+        RaftModelCfg(server_count=1, max_term=1, lossy=False)
+        .into_model()
+        .checker()
+        .spawn_bfs()
+        .join()
+    )
+    path = c.discoveries()["leader elected"]
+    (state,) = [
+        s for s in path.last_state().actor_states if s.role == LEADER
+    ]
+    assert state.term == 1
+
+
+def test_crash_faults_preserve_election_safety():
+    c = (
+        RaftModelCfg(server_count=3, max_term=1, lossy=False, max_crashes=1)
+        .into_model()
+        .checker()
+        .spawn_bfs()
+        .join()
+    )
+    assert "election safety" not in c.discoveries()

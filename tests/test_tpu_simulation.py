@@ -1,0 +1,86 @@
+"""TPU simulation checker: vmapped random-walk lanes.
+
+Mirrors the host simulation test strategy (discovery validity, not exact
+counts — random walks are approximate by design); discovery paths must
+replay through the host model like every device checker's.
+"""
+
+import pytest
+
+from stateright_tpu.models.two_phase_commit import TwoPhaseSys
+
+
+def test_tpu_simulation_finds_sometimes_properties():
+    # 2pc's holding "consistent" always-property can never be discovered, so
+    # (like the reference) simulation would sample forever without a target.
+    checker = (
+        TwoPhaseSys(3)
+        .checker()
+        .target_state_count(50_000)
+        .spawn_tpu_simulation(seed=7, lanes=128, steps_per_call=32)
+        .join()
+    )
+    assert checker.worker_error() is None
+    paths = checker.discoveries()
+    assert "abort agreement" in paths and "commit agreement" in paths
+
+
+def test_tpu_simulation_respects_target_state_count():
+    checker = (
+        TwoPhaseSys(3)
+        .checker()
+        .target_state_count(5_000)
+        .spawn_tpu_simulation(seed=3, lanes=64, steps_per_call=16)
+        .join()
+    )
+    assert checker.worker_error() is None
+    assert checker.state_count() >= 1
+    assert checker.unique_state_count() == checker.state_count()
+
+
+def test_tpu_simulation_discovery_paths_replay():
+    checker = (
+        TwoPhaseSys(3)
+        .checker()
+        .target_state_count(20_000)
+        .spawn_tpu_simulation(seed=11, lanes=256, steps_per_call=32)
+        .join()
+    )
+    assert checker.worker_error() is None
+    for name, path in checker.discoveries().items():
+        final = path.last_state()
+        if name == "abort agreement":
+            assert all(s == "Aborted" for s in final.rm_state)
+        if name == "commit agreement":
+            assert all(s == "Committed" for s in final.rm_state)
+
+
+def test_tpu_simulation_max_depth_cap():
+    checker = (
+        TwoPhaseSys(3)
+        .checker()
+        .target_max_depth(4)
+        .target_state_count(2_000)
+        .spawn_tpu_simulation(seed=5, lanes=64, steps_per_call=16)
+        .join()
+    )
+    assert checker.worker_error() is None
+    assert checker.max_depth() <= 4
+
+
+def test_tpu_simulation_rejects_symmetry():
+    with pytest.raises(NotImplementedError):
+        TwoPhaseSys(3).checker().symmetry().spawn_tpu_simulation(seed=1)
+
+
+def test_tpu_simulation_rejects_non_batchable():
+    from stateright_tpu import FnModel
+
+    def fn(prev, out):
+        if prev is None:
+            out.append(0)
+        elif prev < 3:
+            out.append(prev + 1)
+
+    with pytest.raises(TypeError):
+        FnModel(fn).checker().spawn_tpu_simulation(seed=1)
