@@ -148,12 +148,17 @@ class RegisterClient(Actor):
         o.send(Id(index % server_count), Put(unique_request_id, value))
         return ClientState(awaiting=unique_request_id, op_count=1)
 
+    def _completes_put(self, msg) -> bool:
+        """Whether ``msg`` completes an outstanding Put (the write-once
+        variant also accepts PutFail)."""
+        return isinstance(msg, PutOk)
+
     def on_msg(self, id: Id, state: ClientState, src: Id, msg, o: Out):
         if not isinstance(state, ClientState) or state.awaiting is None:
             return None
         index = int(id)
         server_count = self.server_count
-        if isinstance(msg, PutOk) and msg.request_id == state.awaiting:
+        if self._completes_put(msg) and msg.request_id == state.awaiting:
             unique_request_id = (state.op_count + 1) * index
             if state.op_count < self.put_count:
                 value = chr(ord("Z") - (index - server_count))

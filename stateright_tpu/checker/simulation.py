@@ -189,8 +189,10 @@ class SimulationChecker(Checker):
                 break  # terminal: still check eventually properties below
 
         for i, prop in enumerate(properties):
-            # Insert-if-vacant — see the matching note in bfs.py.
-            if i in ebits and prop.name not in discoveries:
+            # Insert-if-vacant — see the matching note in bfs.py. A trace that
+            # ended before visiting any state (out-of-boundary init) has no
+            # path to report and is skipped.
+            if i in ebits and fingerprint_path and prop.name not in discoveries:
                 discoveries[prop.name] = list(fingerprint_path)
 
     # -- Checker surface ---------------------------------------------------

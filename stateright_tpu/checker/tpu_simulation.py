@@ -94,6 +94,7 @@ class TpuSimulationChecker(Checker):
         self._state_count = 0
         self._max_depth = 0
         self._discoveries_fps: Dict[str, List[int]] = {}
+        self._empty_discoveries: set = set()
         self._done_event = threading.Event()
         self._error: Optional[BaseException] = None
 
@@ -315,9 +316,18 @@ class TpuSimulationChecker(Checker):
                 for i, p in enumerate(props):
                     if found[i] and p.name not in self._discoveries_fps:
                         n = int(lens[i])
+                        if n == 0:
+                            # A lane whose trace ended before visiting any
+                            # state (out-of-boundary init) has no path to
+                            # report; count it as settled so the run can end,
+                            # but surface no (empty) Path.
+                            self._empty_discoveries.add(p.name)
+                            continue
+                        self._empty_discoveries.discard(p.name)
                         fps = ((hi[i, :n] << np.uint64(32)) | lo[i, :n]).tolist()
                         self._discoveries_fps[p.name] = fps
-            if len(self._discoveries_fps) == len(props):
+            settled = set(self._discoveries_fps) | self._empty_discoveries
+            if len(settled) == len(props):
                 return
             if (
                 self._target_state_count is not None

@@ -1,0 +1,21 @@
+"""Utility types (reference layer L0, ``/root/reference/src/util*``).
+
+The reference's ``HashableHashSet``/``HashableHashMap`` (order-insensitive
+hashing wrappers, ``src/util.rs:73-461``) need no Python counterpart: plain
+``frozenset``/``dict`` values are hashed order-insensitively by the stable
+fingerprint encoder (``stateright_tpu.core.fingerprint``), and
+``utils.rewrite.canonical_sort_key`` provides the deterministic total order
+the reference gets from ``Ord``-by-hash.
+"""
+
+from .dense_nat_map import DenseNatMap
+from .rewrite import RewritePlan, canonical_sort_key, rewrite_value
+from .vector_clock import VectorClock
+
+__all__ = [
+    "DenseNatMap",
+    "RewritePlan",
+    "VectorClock",
+    "canonical_sort_key",
+    "rewrite_value",
+]

@@ -1,0 +1,196 @@
+"""Ordered-reliable-link and write-once-register adapter tests.
+
+The ORL scenario mirrors the reference's test shape
+(``/root/reference/src/actor/ordered_reliable_link.rs``): a sender pushes a
+sequence over a lossy duplicating network; with the ORL wrapper the receiver
+sees exactly-once in-order delivery on every schedule.
+"""
+
+from dataclasses import dataclass
+from typing import Tuple
+
+from stateright_tpu.actor import (
+    Actor,
+    ActorModel,
+    Id,
+    Network,
+    Out,
+)
+from stateright_tpu.actor.ordered_reliable_link import (
+    ActorWrapper,
+    NETWORK_TIMER,
+    OrlState,
+    ack_msg,
+    deliver_msg,
+)
+from stateright_tpu.core.model import Expectation
+
+
+@dataclass(frozen=True)
+class SenderState:
+    pass
+
+
+class Sender(Actor):
+    def __init__(self, dst: Id, values: Tuple[str, ...]):
+        self.dst = dst
+        self.values = values
+
+    def on_start(self, id: Id, o: Out) -> SenderState:
+        for v in self.values:
+            o.send(self.dst, v)
+        return SenderState()
+
+
+@dataclass(frozen=True)
+class ReceiverState:
+    received: Tuple[str, ...]
+
+
+class Receiver(Actor):
+    def on_start(self, id: Id, o: Out) -> ReceiverState:
+        return ReceiverState(received=())
+
+    def on_msg(self, id: Id, state: ReceiverState, src: Id, msg, o: Out):
+        return ReceiverState(received=state.received + (msg,))
+
+
+def _orl_model():
+    model = ActorModel(cfg=None, init_history=None)
+    model.actor(ActorWrapper(Sender(Id(1), ("a", "b"))))
+    model.actor(ActorWrapper(Receiver()))
+    order = {"a": 0, "b": 1}
+
+    def no_redelivery(_m, state):
+        received = state.actor_states[1].wrapped_state.received
+        return all(received.count(v) < 2 for v in ("a", "b"))
+
+    def ordered(_m, state):
+        # Non-decreasing, like the reference's "ordered" property: a later
+        # message may overtake (and thereby permanently skip) a dropped
+        # earlier one, but delivery never reorders.
+        received = state.actor_states[1].wrapped_state.received
+        indices = [order[v] for v in received]
+        return indices == sorted(indices)
+
+    def all_delivered(_m, state):
+        return state.actor_states[1].wrapped_state.received == ("a", "b")
+
+    return (
+        model.init_network(Network.new_unordered_duplicating())
+        .lossy_network(True)
+        .within_boundary_fn(lambda _cfg, state: len(state.network) < 4)
+        .property(Expectation.ALWAYS, "no redelivery", no_redelivery)
+        .property(Expectation.ALWAYS, "ordered", ordered)
+        .property(Expectation.SOMETIMES, "all delivered", all_delivered)
+    )
+
+
+class TestOrderedReliableLink:
+    def test_exactly_once_in_order_under_loss_and_duplication(self):
+        checker = _orl_model().checker().spawn_bfs().join()
+        assert "no redelivery" not in checker.discoveries()
+        assert "ordered" not in checker.discoveries()
+        assert "all delivered" in checker.discoveries()
+        assert checker.unique_state_count() > 0
+
+    def test_on_start_wraps_sends_with_sequencers(self):
+        o = Out()
+        state = ActorWrapper(Sender(Id(1), ("a", "b"))).on_start(Id(0), o)
+        assert state.next_send_seq == 3
+        assert state.msgs_pending_ack == ((1, Id(1), "a"), (2, Id(1), "b"))
+        kinds = [c.kind for c in o]
+        assert kinds == ["SetTimer", "Send", "Send"]
+
+    def test_duplicate_deliver_is_acked_but_dropped(self):
+        wrapper = ActorWrapper(Receiver())
+        o = Out()
+        state = wrapper.on_start(Id(1), o)
+        o = Out()
+        state2 = wrapper.on_msg(Id(1), state, Id(0), deliver_msg(1, "a"), o)
+        assert state2.wrapped_state.received == ("a",)
+        o = Out()
+        again = wrapper.on_msg(Id(1), state2, Id(0), deliver_msg(1, "a"), o)
+        assert again is None  # dropped…
+        assert [c.kind for c in o] == ["Send"]  # …but still acked
+
+    def test_ack_clears_pending(self):
+        wrapper = ActorWrapper(Sender(Id(1), ("a",)))
+        state = wrapper.on_start(Id(0), Out())
+        o = Out()
+        next_state = wrapper.on_msg(Id(0), state, Id(1), ack_msg(1), o)
+        assert next_state.msgs_pending_ack == ()
+        # Second identical ack is a no-op.
+        assert wrapper.on_msg(Id(0), next_state, Id(1), ack_msg(1), Out()) is None
+
+    def test_network_timer_resends_pending(self):
+        wrapper = ActorWrapper(Sender(Id(1), ("a", "b")))
+        state = wrapper.on_start(Id(0), Out())
+        o = Out()
+        assert wrapper.on_timeout(Id(0), state, NETWORK_TIMER, o) is None
+        sends = [c for c in o if c.kind == "Send"]
+        assert [c.args for c in sends] == [
+            (Id(1), deliver_msg(1, "a")),
+            (Id(1), deliver_msg(2, "b")),
+        ]
+
+
+class TestWORegister:
+    def test_client_round_trip_with_write_once_server(self):
+        from stateright_tpu.actor.write_once_register import (
+            Get,
+            GetOk,
+            Put,
+            PutFail,
+            PutOk,
+            WORegisterClient,
+            record_invocations,
+            record_returns,
+        )
+        from stateright_tpu.semantics import LinearizabilityTester
+        from stateright_tpu.semantics.write_once_register import WORegister
+
+        @dataclass(frozen=True)
+        class ServerState:
+            value: object
+
+        class WOServer(Actor):
+            def on_start(self, id: Id, o: Out) -> ServerState:
+                return ServerState(value=None)
+
+            def on_msg(self, id: Id, state: ServerState, src: Id, msg, o: Out):
+                if isinstance(msg, Put):
+                    if state.value is None:
+                        o.send(src, PutOk(msg.request_id))
+                        return ServerState(value=msg.value)
+                    if state.value == msg.value:
+                        o.send(src, PutOk(msg.request_id))
+                        return None
+                    o.send(src, PutFail(msg.request_id))
+                    return None
+                if isinstance(msg, Get):
+                    o.send(src, GetOk(msg.request_id, state.value))
+                    return None
+                return None
+
+        model = ActorModel(
+            cfg=None, init_history=LinearizabilityTester(WORegister())
+        )
+        model.actor(WOServer())
+        model.actor(WORegisterClient(put_count=1, server_count=1))
+        model.actor(WORegisterClient(put_count=1, server_count=1))
+        checker = (
+            model.init_network(Network.new_unordered_nonduplicating())
+            .property(
+                Expectation.ALWAYS,
+                "linearizable",
+                lambda _, state: state.history.serialized_history() is not None,
+            )
+            .record_msg_in(record_returns)
+            .record_msg_out(record_invocations)
+            .checker()
+            .spawn_bfs()
+            .join()
+        )
+        checker.assert_properties()
+        assert checker.unique_state_count() > 0
