@@ -64,7 +64,15 @@ class TpuBfsChecker(Checker):
     by doubling + rehash).
     """
 
-    def __init__(self, options, frontier_capacity=1 << 13, table_capacity=1 << 16):
+    def __init__(
+        self,
+        options,
+        frontier_capacity=1 << 13,
+        table_capacity=1 << 16,
+        checkpoint_path=None,
+        checkpoint_every_waves=32,
+        resume_from=None,
+    ):
         model = options.model
         if not isinstance(model, BatchableModel):
             raise TypeError(
@@ -97,6 +105,10 @@ class TpuBfsChecker(Checker):
         self._visitor = options._visitor
         self._target_state_count: Optional[int] = options._target_state_count
         self._depth_cap = options._target_max_depth or _DEPTH_INF
+
+        self._checkpoint_path = checkpoint_path
+        self._checkpoint_every = max(1, checkpoint_every_waves)
+        self._resume_from = resume_from
 
         self._state_count = 0
         self._unique_count = 0
@@ -300,40 +312,13 @@ class TpuBfsChecker(Checker):
         # compilation; benchmarks subtract it to report steady-state rate.
         self.warmup_seconds: Optional[float] = None
         props = self._properties
-        table = hashset_new(self._capacity)
-        while True:
-            out = self._jit_init(table)
-            if not int(out["overflow"]):
-                break
-            table = hashset_new(self._capacity * 2)
-            self._capacity *= 2
-        table = out["table"]
-        self._state_count = int(out["n_valid"])
-        self._unique_count = int(out["n_unique"])
-        hi = np.asarray(out["hi"])
-        lo = np.asarray(out["lo"])
-        valid = np.asarray(out["valid"])
-        child64 = ((hi.astype(np.uint64) << np.uint64(32)) | lo.astype(np.uint64))[
-            valid
-        ]
-        self._wave_log.append((child64, np.zeros_like(child64)))
-
-        F0 = hi.shape[0]
-        init_arrs = {
-            "states": out["states"],
-            "hi": out["hi"],
-            "lo": out["lo"],
-            "ebits": jnp.full((F0,), self._ebits0, jnp.uint32),
-            "depth": jnp.ones((F0,), jnp.int32),
-            "mask": out["valid"],
-        }
-        target0 = -(-F0 // self._F_max) * self._F_max
-        padded0 = self._jit_finish(init_arrs, jnp.int32(0), target0)
-        queue = deque()
-        for start in range(0, F0, self._F_max):
-            queue.append(self._jit_take(padded0, jnp.int32(start), self._F_max))
+        if self._resume_from is not None:
+            table, queue = self._restore(self._resume_from)
+        else:
+            table, queue = self._seed()
         depth_cap = jnp.int32(self._depth_cap)
 
+        waves = 0
         while queue:
             if not props:
                 break
@@ -344,6 +329,13 @@ class TpuBfsChecker(Checker):
                 and self._target_state_count <= self._state_count
             ):
                 break
+            if (
+                self._checkpoint_path is not None
+                and waves
+                and waves % self._checkpoint_every == 0
+            ):
+                self.save_checkpoint(self._checkpoint_path, queue)
+            waves += 1
             chunk = queue.popleft()
             F = chunk["hi"].shape[0]
             B = F * self._A
@@ -390,6 +382,147 @@ class TpuBfsChecker(Checker):
                     break
                 table = self._grow_table(table, self._capacity * 2)
                 attempt += 1
+
+    def _seed(self):
+        """Inserts + enqueues the initial states; returns (table, queue)."""
+        table = hashset_new(self._capacity)
+        while True:
+            out = self._jit_init(table)
+            if not int(out["overflow"]):
+                break
+            table = hashset_new(self._capacity * 2)
+            self._capacity *= 2
+        table = out["table"]
+        self._state_count = int(out["n_valid"])
+        self._unique_count = int(out["n_unique"])
+        hi = np.asarray(out["hi"])
+        lo = np.asarray(out["lo"])
+        valid = np.asarray(out["valid"])
+        child64 = ((hi.astype(np.uint64) << np.uint64(32)) | lo.astype(np.uint64))[
+            valid
+        ]
+        self._wave_log.append((child64, np.zeros_like(child64)))
+
+        F0 = hi.shape[0]
+        init_arrs = {
+            "states": out["states"],
+            "hi": out["hi"],
+            "lo": out["lo"],
+            "ebits": jnp.full((F0,), self._ebits0, jnp.uint32),
+            "depth": jnp.ones((F0,), jnp.int32),
+            "mask": out["valid"],
+        }
+        target0 = -(-F0 // self._F_max) * self._F_max
+        padded0 = self._jit_finish(init_arrs, jnp.int32(0), target0)
+        queue = deque()
+        for start in range(0, F0, self._F_max):
+            queue.append(self._jit_take(padded0, jnp.int32(start), self._F_max))
+        return table, queue
+
+    # -- checkpoint/resume (new capability: the reference loses all progress
+    # on a kill, SURVEY §5) ------------------------------------------------
+
+    def _model_digest(self) -> str:
+        """Digest of the model's packed configuration: the class-name check
+        alone would let e.g. a 3-RM checkpoint resume a 4-RM model."""
+        from hashlib import blake2b
+
+        h = blake2b(digest_size=16)
+        h.update(type(self._model).__name__.encode())
+        h.update(str(self._A).encode())
+        for leaf in jax.tree_util.tree_leaves(self._model.packed_init_states()):
+            arr = np.asarray(leaf)
+            h.update(str(arr.shape).encode())
+            h.update(str(arr.dtype).encode())
+            h.update(arr.tobytes())
+        return h.hexdigest()
+
+    def save_checkpoint(self, path, queue) -> None:
+        """Atomically serializes counters, discoveries, the parent-pointer
+        map, and the pending frontier chunks. The visited set is not stored
+        separately — it is exactly the parent map's keys, and the device
+        table is rebuilt from them on resume."""
+        import os
+        import pickle
+
+        self._ingest_wave_log()
+        children = np.fromiter(
+            self._parent_map.keys(), dtype=np.uint64, count=len(self._parent_map)
+        )
+        parents = np.fromiter(
+            (p or 0 for p in self._parent_map.values()),
+            dtype=np.uint64,
+            count=len(self._parent_map),
+        )
+        payload = {
+            "version": 1,
+            "model": type(self._model).__name__,
+            "model_digest": self._model_digest(),
+            "state_count": self._state_count,
+            "unique_count": self._unique_count,
+            "max_depth": self._max_depth,
+            "discoveries": dict(self._discoveries_fp),
+            "children": children,
+            "parents": parents,
+            "capacity": self._capacity,
+            "chunks": [
+                jax.tree_util.tree_map(np.asarray, chunk) for chunk in queue
+            ],
+        }
+        tmp = f"{path}.tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(payload, f)
+        os.replace(tmp, path)
+
+    def _restore(self, path):
+        import pickle
+
+        with open(path, "rb") as f:
+            payload = pickle.load(f)
+        if payload.get("version") != 1:
+            raise ValueError(f"unsupported checkpoint version: {payload!r}")
+        if payload["model"] != type(self._model).__name__:
+            raise ValueError(
+                f"checkpoint was written by model {payload['model']!r}, "
+                f"resuming with {type(self._model).__name__!r}"
+            )
+        if payload.get("model_digest") != self._model_digest():
+            raise ValueError(
+                "checkpoint was written by a differently-configured model "
+                "(packed init states / action count do not match); resuming "
+                "would mix two state spaces"
+            )
+        self._state_count = payload["state_count"]
+        self._unique_count = payload["unique_count"]
+        self._max_depth = payload["max_depth"]
+        self._discoveries_fp = dict(payload["discoveries"])
+        children = payload["children"]
+        parents = payload["parents"]
+        self._wave_log.append((children, parents))
+
+        # Rebuild the device visited set by claim-inserting all known keys.
+        self._capacity = max(self._capacity, payload["capacity"])
+        table = hashset_new(self._capacity)
+        hi = (children >> np.uint64(32)).astype(np.uint32)
+        lo = (children & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+        batch = 1 << 16
+        for start in range(0, len(children), batch):
+            bh = jnp.asarray(hi[start : start + batch])
+            bl = jnp.asarray(lo[start : start + batch])
+            active = jnp.ones((bh.shape[0],), bool)
+            table, _fresh, _found, pending = hashset_insert(
+                table, bh, bl, active
+            )
+            if int(pending.sum()):
+                table = self._grow_table(table, self._capacity * 2)
+                table, _f, _fo, pend2 = hashset_insert(table, bh, bl, active)
+                if int(pend2.sum()):
+                    raise RuntimeError("checkpoint restore overflowed table")
+        queue = deque(
+            jax.tree_util.tree_map(jnp.asarray, chunk)
+            for chunk in payload["chunks"]
+        )
+        return table, queue
 
     def _log_wave(self, wave, n_new):
         hi = np.asarray(wave["new"]["hi"])[:n_new].astype(np.uint64)

@@ -1,0 +1,151 @@
+// stateright_tpu explorer — minimal vanilla-JS client for the Explorer API:
+//   GET  /.status               checker progress + property discoveries
+//   GET  /.states/fp1/fp2/...   replay a fingerprint path, list next steps
+//   POST /.runtocompletion      unblock the on-demand checker
+"use strict";
+
+const state = {
+  path: [],        // fingerprints (strings) from an init state
+  labels: [],      // action label per path entry
+  steps: [],       // next steps at the current state
+  selected: 0,
+};
+
+const $ = (id) => document.getElementById(id);
+
+// Server strings (state reprs, action labels, property names) are untrusted
+// for HTML purposes — escape everything interpolated into innerHTML.
+function esc(text) {
+  return String(text)
+    .replaceAll("&", "&amp;").replaceAll("<", "&lt;").replaceAll(">", "&gt;")
+    .replaceAll('"', "&quot;").replaceAll("'", "&#39;");
+}
+
+async function getJSON(url, opts) {
+  const response = await fetch(url, opts);
+  if (!response.ok) throw new Error(`${url}: ${response.status}`);
+  return response.json();
+}
+
+function badge(status) {
+  const symbol = { ok: "✅", witnessed: "✅", violated: "❌", pending: "⏳" }[status] || "·";
+  return `<span class="badge ${status}">${symbol}</span>`;
+}
+
+async function refreshStatus() {
+  try {
+    const s = await getJSON("/.status");
+    $("status").textContent =
+      `states=${s.state_count} unique=${s.unique_state_count} ` +
+      `depth=${s.max_depth}${s.done ? " (done)" : ""}`;
+    const items = s.properties.map((p) => {
+      let extra = "";
+      if (p.discovery) {
+        const kind = p.expectation === "always" ? "counterexample" : "example";
+        extra = ` <a href="#" class="discovery" data-fps="${esc(p.discovery.fingerprints)}">${kind}</a>`;
+      }
+      const status = p.discovery
+        ? (p.expectation === "always" ? "violated" : "witnessed")
+        : "pending";
+      return `<li>${badge(status)} <b>${esc(p.expectation)}</b> ${esc(p.name)}${extra}</li>`;
+    });
+    $("properties").innerHTML = items.join("");
+    document.querySelectorAll(".discovery").forEach((a) =>
+      a.addEventListener("click", (e) => {
+        e.preventDefault();
+        followFingerprints(a.dataset.fps.split("/"));
+      }));
+  } catch (err) {
+    $("status").textContent = `status error: ${err.message}`;
+  }
+}
+
+async function refreshSteps() {
+  const url = "/.states/" + state.path.join("/");
+  const view = await getJSON(url);
+  state.steps = view.next_steps;
+  state.selected = 0;
+  $("current-state").textContent = view.state || "(choose an initial state)";
+  renderPath();
+  renderSteps();
+  $("svg-panel").innerHTML = view.svg || "";
+}
+
+function renderPath() {
+  $("path").innerHTML = state.labels
+    .map((label, i) => `<li data-i="${i}">${esc(label)}</li>`)
+    .join("");
+  document.querySelectorAll("#path li").forEach((li) =>
+    li.addEventListener("click", () => {
+      const n = Number(li.dataset.i) + 1;
+      state.path = state.path.slice(0, n);
+      state.labels = state.labels.slice(0, n);
+      refreshSteps();
+    }));
+}
+
+function renderSteps() {
+  $("steps").innerHTML = state.steps
+    .map((step, i) => {
+      const label = step.action === null ? "(init)" : step.action;
+      const props = (step.properties || [])
+        .map((p) => badge(p.status))
+        .join("");
+      const selected = i === state.selected ? " selected" : "";
+      return `<li class="step${selected}" data-i="${i}">` +
+        `<b>${esc(label)}</b> ${props}<pre>${esc(step.outcome)}</pre></li>`;
+    })
+    .join("");
+  document.querySelectorAll("#steps .step").forEach((li) =>
+    li.addEventListener("click", () => takeStep(Number(li.dataset.i))));
+}
+
+function takeStep(i) {
+  const step = state.steps[i];
+  if (!step) return;
+  state.path.push(step.fingerprint);
+  state.labels.push(step.action === null ? "(init)" : step.action);
+  refreshSteps();
+}
+
+async function followFingerprints(fps) {
+  // Walk a discovery path fingerprint by fingerprint, labeling from the
+  // server's step info at each hop.
+  state.path = [];
+  state.labels = [];
+  for (const fp of fps) {
+    const view = await getJSON("/.states/" + state.path.join("/"));
+    const match = view.next_steps.find((s) => s.fingerprint === fp);
+    state.path.push(fp);
+    state.labels.push(match ? (match.action === null ? "(init)" : match.action) : fp);
+  }
+  refreshSteps();
+}
+
+document.addEventListener("keydown", (e) => {
+  if (e.key === "j") {
+    state.selected = Math.min(state.selected + 1, state.steps.length - 1);
+    renderSteps();
+  } else if (e.key === "k") {
+    state.selected = Math.max(state.selected - 1, 0);
+    renderSteps();
+  } else if (e.key === "Enter") {
+    takeStep(state.selected);
+  } else if (e.key === "Backspace") {
+    state.path.pop();
+    state.labels.pop();
+    refreshSteps();
+  }
+});
+
+$("run").addEventListener("click", () =>
+  fetch("/.runtocompletion", { method: "POST" }).then(refreshStatus));
+$("reset").addEventListener("click", () => {
+  state.path = [];
+  state.labels = [];
+  refreshSteps();
+});
+
+refreshSteps();
+refreshStatus();
+setInterval(refreshStatus, 1000);

@@ -1,0 +1,97 @@
+"""Checkpoint/resume for the TPU BFS checker.
+
+New capability (SURVEY §5 flags the reference's lack: a killed check loses
+all progress). Wave-granular: the parent-pointer map + pending frontier
+chunks serialize; the device visited set is rebuilt from the parent map's
+keys on resume.
+"""
+
+import pytest
+
+from stateright_tpu.models.two_phase_commit import TwoPhaseSys
+
+
+def test_resume_completes_the_space(tmp_path):
+    ckpt = tmp_path / "2pc.ckpt"
+    first = (
+        TwoPhaseSys(4)
+        .checker()
+        .target_state_count(500)  # stop early, leaving work pending
+        .spawn_tpu_bfs(
+            frontier_capacity=64,
+            checkpoint_path=str(ckpt),
+            checkpoint_every_waves=1,
+        )
+        .join()
+    )
+    assert first.worker_error() is None
+    assert ckpt.exists()
+    assert first.unique_state_count() < 1568
+
+    resumed = (
+        TwoPhaseSys(4)
+        .checker()
+        .spawn_tpu_bfs(frontier_capacity=64, resume_from=str(ckpt))
+        .join()
+    )
+    assert resumed.worker_error() is None
+    assert resumed.unique_state_count() == 1568
+    resumed.assert_properties()
+    # Discovery paths replay through the restored parent map.
+    for path in resumed.discoveries().values():
+        assert len(path) >= 1
+
+
+def test_resume_rejects_non_batchable_model(tmp_path):
+    from stateright_tpu.models.raft import RaftModelCfg
+
+    checker = RaftModelCfg(server_count=3, max_term=1).into_model().checker()
+    with pytest.raises(TypeError):
+        checker.spawn_tpu_bfs(resume_from=str(tmp_path / "nope.ckpt"))
+
+
+def test_resume_rejects_differently_configured_model(tmp_path):
+    ckpt = tmp_path / "2pc.ckpt"
+    TwoPhaseSys(3).checker().target_state_count(50).spawn_tpu_bfs(
+        frontier_capacity=64,
+        checkpoint_path=str(ckpt),
+        checkpoint_every_waves=1,
+    ).join()
+    assert ckpt.exists()
+
+    # Same class, different parameters: mixing the 3-RM visited set into a
+    # 4-RM search must be refused, not silently corrupted.
+    resumed = TwoPhaseSys(4).checker().spawn_tpu_bfs(
+        frontier_capacity=64, resume_from=str(ckpt)
+    )
+    with pytest.raises(RuntimeError):
+        resumed.join()
+    err = resumed.worker_error()
+    assert isinstance(err, ValueError)
+    assert "differently-configured" in str(err)
+
+
+def test_checkpoint_counts_are_coherent(tmp_path):
+    ckpt = tmp_path / "2pc3.ckpt"
+    checker = (
+        TwoPhaseSys(3)
+        .checker()
+        .spawn_tpu_bfs(
+            frontier_capacity=32,
+            checkpoint_path=str(ckpt),
+            checkpoint_every_waves=1,
+        )
+        .join()
+    )
+    assert checker.worker_error() is None
+    assert checker.unique_state_count() == 288
+    # Resuming a finished run is a no-op continuation that converges to the
+    # same counts.
+    resumed = (
+        TwoPhaseSys(3)
+        .checker()
+        .spawn_tpu_bfs(frontier_capacity=32, resume_from=str(ckpt))
+        .join()
+    )
+    assert resumed.worker_error() is None
+    assert resumed.unique_state_count() == 288
