@@ -1,0 +1,530 @@
+"""Packed actor systems: staging ``ActorModel`` transitions onto the TPU.
+
+The host ``ActorModel`` (``stateright_tpu.actor.model``) enumerates
+data-dependent action sets and runs arbitrary Python actor callbacks — the
+reference's design (``/root/reference/src/actor/model.rs:214-649``), which
+cannot be traced. This module provides the fixed-width staged equivalent
+(SURVEY §2.2 names ``ActorModel`` "the prime candidate for the fixed-width
+staged transition function"):
+
+- **actor rows**: per-actor state packs into a ``(N, R)`` u32 matrix;
+- **network table**: a bounded ``(E,)``-slot envelope table (src, dst,
+  msg words, count) kept *canonically sorted* so identical envelope
+  multisets produce identical arrays (the host hashes networks
+  order-insensitively; sorting is the device analog);
+- **timers**: one bitmask word per actor;
+- **dense actions**: ``E`` Deliver ids + ``E`` Drop ids (lossy only) +
+  ``N×T`` Timeout ids, each with a traceable guard;
+- **actor callbacks**: each actor type supplies jax-traceable
+  ``on_msg``/``on_timeout`` kernels via an ``ActorPackedCodec``;
+  heterogeneous systems dispatch with ``lax.switch``.
+
+Parity-scoped v1 (each limit raises loudly, host checkers remain available
+for the rest): unordered networks only (ordered FIFO flows need ring
+buffers), no auxiliary history (``LinearizabilityTester`` histories are
+host-only by design — SURVEY §7 hard parts), and no crash faults (the host
+state hash deliberately excludes ``crashed``, which device fingerprints
+cannot reproduce without aliasing distinct live states).
+
+The transition semantics mirror the host model exactly — no-op pruning
+(``is_no_op``/``is_no_op_with_timer``), deliver-before-send network
+effects, fired-timer clearing before command processing — so packed and
+host checkers agree on exact state counts (the parity test contract).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional
+
+import numpy as np
+
+from ..core.batch import BatchableModel
+from .actor import Id, Out
+from .model import ActorModel
+from .model_state import ActorModelState
+from .network import (
+    Envelope,
+    Network,
+    ORDERED,
+    UNORDERED_DUPLICATING,
+    UNORDERED_NONDUPLICATING,
+)
+from .timers import Timers
+
+
+class ActorPackedCodec:
+    """Model-specific packing contract consumed by ``PackedActorModel``.
+
+    Widths are static; the traceable kernels receive/return u32 arrays:
+
+    - ``on_msg`` branch (one per actor type):
+      ``fn(id, row, src, msg) -> (row', sends, set_bits, cancel_bits, changed)``
+      with ``id``/``src`` scalar i32, ``row`` ``(R,)`` u32, ``msg`` ``(W,)``
+      u32, ``sends`` ``(S, 1+W)`` u32 (column 0 = destination id, or
+      ``SEND_NONE`` for unused rows), timer masks scalar u32, ``changed``
+      scalar bool (the analog of returning a new state vs ``None``).
+    - ``on_timeout`` branch: ``fn(id, row, timer_id) -> same``.
+    """
+
+    SEND_NONE = np.uint32(0xFFFFFFFF)
+
+    msg_width: int
+    state_width: int
+    timer_values: List[Any]  # timer value -> bit index by position
+    send_capacity: int
+
+    # -- host <-> packed conversions --------------------------------------
+
+    def pack_actor_state(self, actor_index: int, state) -> np.ndarray:
+        raise NotImplementedError
+
+    def unpack_actor_state(self, actor_index: int, row: np.ndarray):
+        raise NotImplementedError
+
+    def pack_msg(self, msg) -> np.ndarray:
+        raise NotImplementedError
+
+    def unpack_msg(self, vec: np.ndarray):
+        raise NotImplementedError
+
+    # -- traceable kernels -------------------------------------------------
+
+    def actor_type_id(self, actor_index: int, actor) -> int:
+        return 0
+
+    def on_msg_branches(self, model) -> List[Callable]:
+        raise NotImplementedError
+
+    def on_timeout_branches(self, model) -> List[Callable]:
+        raise NotImplementedError
+
+    # -- traceable model hooks ---------------------------------------------
+
+    def packed_conditions(self, model) -> List[Callable]:
+        raise NotImplementedError
+
+    def packed_within_boundary(self, model, state) -> Any:
+        import jax.numpy as jnp
+
+        return jnp.bool_(True)
+
+
+class PackedActorModel(ActorModel, BatchableModel):
+    """An ``ActorModel`` that additionally implements the packed protocol.
+
+    Build it exactly like an ``ActorModel`` (``.actor()``,
+    ``.init_network()``, ``.property()``, …) and attach a codec; the packed
+    side is validated lazily on first use so host-only checking of
+    unsupported configurations still works.
+    """
+
+    def __init__(self, codec: ActorPackedCodec, cfg=None, init_history=None):
+        super().__init__(cfg=cfg, init_history=init_history)
+        self.codec = codec
+        self.envelope_capacity = 32
+
+    def with_envelope_capacity(self, capacity: int) -> "PackedActorModel":
+        """Sets the network table's slot count. Must upper-bound the
+        reachable distinct-envelope count: overflowing transitions are
+        pruned, which the exact-count parity tests surface as a mismatch."""
+        self.envelope_capacity = capacity
+        return self
+
+    # -- validation --------------------------------------------------------
+
+    def _packed_check(self):
+        if self.init_history is not None:
+            raise NotImplementedError(
+                "packed actor systems do not support auxiliary history "
+                "(consistency-tester properties evaluate on the host path)"
+            )
+        if self._max_crashes:
+            raise NotImplementedError(
+                "packed actor systems do not support crash faults (the host "
+                "state hash excludes `crashed`, which device fingerprints "
+                "cannot mirror)"
+            )
+        if self._init_network.kind == ORDERED:
+            raise NotImplementedError(
+                "packed actor systems support unordered networks only"
+            )
+        if len(self._init_network.data):
+            raise NotImplementedError(
+                "non-empty initial networks are not packed yet"
+            )
+
+    # -- static shape helpers ----------------------------------------------
+
+    @property
+    def _N(self) -> int:
+        return len(self.actors_list)
+
+    @property
+    def _E(self) -> int:
+        return self.envelope_capacity
+
+    @property
+    def _T(self) -> int:
+        return len(self.codec.timer_values)
+
+    @property
+    def _dup(self) -> bool:
+        return self._init_network.kind == UNORDERED_DUPLICATING
+
+    def _timer_bit(self, timer) -> int:
+        return self.codec.timer_values.index(timer)
+
+    # -- BatchableModel: shape info ---------------------------------------
+
+    def packed_action_count(self) -> int:
+        self._packed_check()
+        deliver_drop = self._E * (2 if self._lossy_network else 1)
+        return deliver_drop + self._N * self._T
+
+    # -- host <-> packed state conversion ----------------------------------
+
+    def pack_state(self, sys_state: ActorModelState):
+        self._packed_check()
+        codec = self.codec
+        N, E, W, R = self._N, self._E, codec.msg_width, codec.state_width
+        rows = np.zeros((N, R), np.uint32)
+        for i, actor_state in enumerate(sys_state.actor_states):
+            rows[i] = codec.pack_actor_state(i, actor_state)
+        timers = np.zeros((N,), np.uint32)
+        for i, tset in enumerate(sys_state.timers_set):
+            for t in tset:
+                timers[i] |= np.uint32(1) << np.uint32(self._timer_bit(t))
+
+        envs = []
+        if self._init_network.kind == UNORDERED_NONDUPLICATING:
+            items = list(sys_state.network.data.items())
+        else:
+            items = [(env, 1) for env in sys_state.network.data]
+        if len(items) > E:
+            raise ValueError(
+                f"state has {len(items)} distinct envelopes; "
+                f"envelope_capacity={E} is too small"
+            )
+        for env, count in items:
+            envs.append(
+                (
+                    int(env.src),
+                    int(env.dst),
+                    tuple(int(x) for x in codec.pack_msg(env.msg)),
+                    int(count),
+                )
+            )
+        envs.sort()
+        net_src = np.zeros((E,), np.uint32)
+        net_dst = np.zeros((E,), np.uint32)
+        net_msg = np.zeros((E, W), np.uint32)
+        net_cnt = np.zeros((E,), np.uint32)
+        for slot, (src, dst, msg, count) in enumerate(envs):
+            net_src[slot] = src
+            net_dst[slot] = dst
+            net_msg[slot] = msg
+            net_cnt[slot] = count
+        return {
+            "rows": rows,
+            "timers": timers,
+            "net_src": net_src,
+            "net_dst": net_dst,
+            "net_msg": net_msg,
+            "net_cnt": net_cnt,
+        }
+
+    def unpack_state(self, packed) -> ActorModelState:
+        codec = self.codec
+        rows = np.asarray(packed["rows"])
+        timers = np.asarray(packed["timers"])
+        actor_states = [
+            codec.unpack_actor_state(i, rows[i]) for i in range(self._N)
+        ]
+        timers_set = []
+        for i in range(self._N):
+            tset = Timers()
+            for b, timer in enumerate(codec.timer_values):
+                if int(timers[i]) & (1 << b):
+                    tset.set(timer)
+            timers_set.append(tset)
+        network = self._init_network.copy()
+        cnt = np.asarray(packed["net_cnt"])
+        src = np.asarray(packed["net_src"])
+        dst = np.asarray(packed["net_dst"])
+        msg = np.asarray(packed["net_msg"])
+        for slot in range(self._E):
+            if int(cnt[slot]):
+                env = Envelope(
+                    src=Id(int(src[slot])),
+                    dst=Id(int(dst[slot])),
+                    msg=codec.unpack_msg(msg[slot]),
+                )
+                for _ in range(int(cnt[slot])):
+                    network.send(env)
+        return ActorModelState(
+            actor_states=actor_states,
+            network=network,
+            timers_set=timers_set,
+            crashed=[False] * self._N,
+            history=None,
+        )
+
+    def packed_init_states(self):
+        import jax.numpy as jnp
+
+        self._packed_check()
+        packed = [self.pack_state(s) for s in self.init_states()]
+        return {
+            k: jnp.stack([np.asarray(p[k]) for p in packed])
+            for k in packed[0]
+        }
+
+    # -- traceable transition ----------------------------------------------
+
+    def _canonicalize(self, state):
+        """Zeroes empty slots and sorts the envelope table so equal
+        multisets produce identical arrays (device analog of the host's
+        order-insensitive network hash)."""
+        import jax
+        import jax.numpy as jnp
+
+        W = self.codec.msg_width
+        cnt = state["net_cnt"]
+        empty = cnt == 0
+        z = jnp.uint32(0)
+        src = jnp.where(empty, z, state["net_src"])
+        dst = jnp.where(empty, z, state["net_dst"])
+        msg = jnp.where(empty[:, None], z, state["net_msg"])
+        cnt = jnp.where(empty, z, cnt)
+        operands = [empty.astype(jnp.uint32), src, dst]
+        operands += [msg[:, w] for w in range(W)]
+        operands += [cnt]
+        out = jax.lax.sort(tuple(operands), num_keys=len(operands))
+        src, dst = out[1], out[2]
+        msg = jnp.stack(out[3 : 3 + W], axis=1) if W else msg
+        cnt = out[3 + W]
+        return {
+            "rows": state["rows"],
+            "timers": state["timers"],
+            "net_src": src,
+            "net_dst": dst,
+            "net_msg": msg,
+            "net_cnt": cnt,
+        }
+
+    def _net_send(self, state, src, dst, msg, active):
+        """One network send (host ``Network.send``): duplicating nets dedup,
+        non-duplicating nets count. Returns (state, overflow)."""
+        import jax.numpy as jnp
+
+        src = src.astype(jnp.uint32)
+        dst = dst.astype(jnp.uint32)
+        cnt = state["net_cnt"]
+        match = (
+            (state["net_src"] == src)
+            & (state["net_dst"] == dst)
+            & (state["net_msg"] == msg[None, :]).all(axis=1)
+            & (cnt > 0)
+        )
+        exists = match.any()
+        first_match = jnp.argmax(match)
+        empty = cnt == 0
+        has_empty = empty.any()
+        claim = jnp.argmax(empty)
+
+        slot = jnp.where(exists, first_match, claim)
+        ok = active & (exists | has_empty)
+        if self._dup:
+            add = jnp.where(exists, jnp.uint32(0), jnp.uint32(1))
+        else:
+            add = jnp.uint32(1)
+        new_cnt = cnt.at[slot].add(jnp.where(ok, add, jnp.uint32(0)))
+        write = ok & ~exists
+        state = dict(state)
+        state["net_src"] = state["net_src"].at[slot].set(
+            jnp.where(write, src, state["net_src"][slot])
+        )
+        state["net_dst"] = state["net_dst"].at[slot].set(
+            jnp.where(write, dst, state["net_dst"][slot])
+        )
+        state["net_msg"] = state["net_msg"].at[slot].set(
+            jnp.where(write, msg, state["net_msg"][slot])
+        )
+        state["net_cnt"] = new_cnt
+        overflow = active & ~exists & ~has_empty
+        return state, overflow
+
+    def _apply_callback(self, state, actor, row_new, sends, set_bits, cancel_bits, fired_bit=None):
+        """Applies a callback's effects: row write, timer bookkeeping
+        (fired timer cleared first, then sets, then cancels — matching the
+        host's sequential command processing for set-then-cancel), sends.
+        Returns (state, overflow)."""
+        import jax.numpy as jnp
+
+        state = dict(state)
+        state["rows"] = state["rows"].at[actor].set(row_new)
+        t = state["timers"][actor]
+        if fired_bit is not None:
+            t = t & ~(jnp.uint32(1) << fired_bit.astype(jnp.uint32))
+        t = (t | set_bits) & ~cancel_bits
+        state["timers"] = state["timers"].at[actor].set(t)
+        overflow = jnp.bool_(False)
+        for s in range(self.codec.send_capacity):
+            dst = sends[s, 0]
+            msg = sends[s, 1:]
+            active = dst != jnp.uint32(self.codec.SEND_NONE)
+            state, ov = self._net_send(
+                state, state_src(actor), dst, msg, active
+            )
+            overflow = overflow | ov
+        return state, overflow
+
+    def packed_step(self, state, action_id):
+        import jax
+        import jax.numpy as jnp
+
+        self._packed_check()
+        codec = self.codec
+        N, E, T, W = self._N, self._E, self._T, codec.msg_width
+        lossy = self._lossy_network
+        aid = action_id.astype(jnp.int32)
+        msg_branches = codec.on_msg_branches(self)
+        timeout_branches = codec.on_timeout_branches(self)
+        type_ids = [
+            codec.actor_type_id(i, a) for i, a in enumerate(self.actors_list)
+        ]
+        type_arr = jnp.asarray(type_ids, jnp.int32)
+
+        deliver_ids = E
+        drop_ids = E if lossy else 0
+        is_deliver = aid < deliver_ids
+        is_drop = lossy & (aid >= deliver_ids) & (aid < deliver_ids + drop_ids)
+        is_timeout = aid >= deliver_ids + drop_ids
+
+        slot = jnp.clip(jnp.where(is_drop, aid - deliver_ids, aid), 0, E - 1)
+        tk = jnp.clip(aid - deliver_ids - drop_ids, 0, N * T - 1)
+        t_actor = tk // T
+        t_bit = (tk % T).astype(jnp.uint32)
+
+        cnt = state["net_cnt"]
+        present = cnt[slot] > 0
+        env_src = state["net_src"][slot].astype(jnp.int32)
+        env_dst = state["net_dst"][slot].astype(jnp.int32)
+        env_msg = state["net_msg"][slot]
+        dst_ok = env_dst < N
+
+        # Which actor's callback runs (clamped for safety; masked by valid).
+        actor = jnp.clip(jnp.where(is_timeout, t_actor, env_dst), 0, N - 1)
+        row = state["rows"][actor]
+
+        def run_msg(args):
+            row, actor, src, msg, bit = args
+            return jax.lax.switch(
+                type_arr[actor],
+                [
+                    (lambda r, a, s, m, fn=fn: fn(a, r, s, m))
+                    for fn in msg_branches
+                ],
+                row,
+                actor,
+                src,
+                msg,
+            )
+
+        def run_timeout(args):
+            row, actor, src, msg, bit = args
+            return jax.lax.switch(
+                type_arr[actor],
+                [
+                    (lambda r, a, b, fn=fn: fn(a, r, b))
+                    for fn in timeout_branches
+                ],
+                row,
+                actor,
+                bit,
+            )
+
+        row_new, sends, set_bits, cancel_bits, changed = jax.lax.cond(
+            is_timeout,
+            run_timeout,
+            run_msg,
+            (row, actor, env_src, env_msg, t_bit),
+        )
+
+        no_sends = (sends[:, 0] == codec.SEND_NONE).all()
+        no_bits_cmds = (set_bits == 0) & (cancel_bits == 0)
+        is_no_op = ~changed & no_sends & no_bits_cmds
+        # Host is_no_op_with_timer: unchanged + exactly a renewal of the
+        # fired timer.
+        renews_only = (
+            ~changed
+            & no_sends
+            & (cancel_bits == 0)
+            & (set_bits == (jnp.uint32(1) << t_bit))
+        )
+
+        timer_set = (
+            (state["timers"][t_actor] >> t_bit) & jnp.uint32(1)
+        ) == 1
+        valid_deliver = is_deliver & present & dst_ok & ~is_no_op
+        valid_drop = is_drop & present
+        valid_timeout = is_timeout & timer_set & ~renews_only
+
+        # -- build each outcome and select ----------------------------------
+
+        # Drop: duplicating removes the envelope entirely; counting nets
+        # decrement (host Network.on_drop).
+        drop_state = dict(state)
+        if self._dup:
+            drop_state["net_cnt"] = cnt.at[slot].set(jnp.uint32(0))
+        else:
+            drop_state["net_cnt"] = cnt.at[slot].add(jnp.uint32(0) - 1)
+
+        # Deliver: network effect first (host: on_deliver before
+        # process_commands), then callback effects.
+        deliver_state = dict(state)
+        if not self._dup:
+            deliver_state["net_cnt"] = cnt.at[slot].add(jnp.uint32(0) - 1)
+        deliver_state, ov_d = self._apply_callback(
+            deliver_state, actor, row_new, sends, set_bits, cancel_bits
+        )
+
+        timeout_state, ov_t = self._apply_callback(
+            dict(state), actor, row_new, sends, set_bits, cancel_bits,
+            fired_bit=t_bit,
+        )
+
+        overflow = (valid_deliver & ov_d) | (valid_timeout & ov_t)
+
+        def pick(a, b, cond):
+            return jax.tree_util.tree_map(
+                lambda x, y: jnp.where(cond, x, y), a, b
+            )
+
+        out = pick(drop_state, deliver_state, is_drop)
+        out = pick(timeout_state, out, is_timeout)
+        valid = (valid_deliver | valid_drop | valid_timeout) & ~overflow
+        # Guard: an invalid lane must still produce canonical arrays.
+        out = self._canonicalize(out)
+        return out, valid
+
+    def packed_conditions(self):
+        self._packed_check()
+        conds = self.codec.packed_conditions(self)
+        if len(conds) != len(self._properties):
+            raise ValueError(
+                "codec.packed_conditions must align with the model's "
+                f"properties: {len(conds)} != {len(self._properties)}"
+            )
+        return conds
+
+    def packed_within_boundary(self, state):
+        return self.codec.packed_within_boundary(self, state)
+
+
+def state_src(actor):
+    """The sender id for commands emitted by ``actor`` (host: commands are
+    processed with ``src = the acting actor``)."""
+    import jax.numpy as jnp
+
+    return actor.astype(jnp.int32)

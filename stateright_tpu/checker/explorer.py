@@ -113,7 +113,12 @@ def states_view(checker, fp_path: List[int]) -> dict:
             )
         return {"path": "", "svg": None, "next_steps": states}
 
-    state = Path.final_state(model, fp_path)
+    replayed = _replay(model, fp_path)
+    state = (
+        replayed.last_state()
+        if replayed is not None
+        else Path.final_state(model, fp_path)
+    )
     if state is None:
         raise KeyError(
             f"no state matches fingerprint path {'/'.join(map(str, fp_path))}"
@@ -131,10 +136,7 @@ def states_view(checker, fp_path: List[int]) -> dict:
                 "properties": _properties_at(model, next_state),
             }
         )
-    svg = None
-    replayed = _replay(model, fp_path)
-    if replayed is not None:
-        svg = model.as_svg(replayed)
+    svg = model.as_svg(replayed) if replayed is not None else None
     return {
         "path": "/".join(str(fp) for fp in fp_path),
         "state": str(state),
@@ -228,7 +230,13 @@ class _Handler(BaseHTTPRequestHandler):
     def _static(self, path: str):
         name = "index.html" if path in ("/", "") else path.lstrip("/")
         file = (_UI_DIR / name).resolve()
-        if not str(file).startswith(str(_UI_DIR)) or not file.is_file():
+        try:
+            inside = file.is_relative_to(_UI_DIR)
+        except AttributeError:  # Python < 3.9
+            import os
+
+            inside = str(file).startswith(str(_UI_DIR) + os.sep)
+        if not inside or not file.is_file():
             self._json({"error": "not found"}, 404)
             return
         body = file.read_bytes()

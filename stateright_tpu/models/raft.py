@@ -27,6 +27,8 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import FrozenSet, List, Optional
 
+import numpy as np
+
 from ..actor import (
     Actor,
     ActorModel,
@@ -36,6 +38,7 @@ from ..actor import (
     model_peers,
     model_timeout,
 )
+from ..actor.packed import ActorPackedCodec, PackedActorModel
 from ..core.model import Expectation
 
 FOLLOWER, CANDIDATE, LEADER = "Follower", "Candidate", "Leader"
@@ -155,6 +158,226 @@ class RaftActor(Actor):
         return None
 
 
+class RaftPackedCodec(ActorPackedCodec):
+    """Packed kernels for ``RaftActor``: the traceable twin of the host
+    callbacks above (state row ``[role, term, voted_for+1, votes_bitmask]``,
+    message ``[kind, term]`` with kinds RequestVote=1 Vote=2 Heartbeat=3).
+    Exact-count parity with the host model is pinned in tests."""
+
+    msg_width = 2
+    state_width = 4
+    timer_values = [ELECTION]
+
+    K_REQUEST_VOTE, K_VOTE, K_HEARTBEAT = 1, 2, 3
+    _KIND_NAME = {1: "RequestVote", 2: "Vote", 3: "Heartbeat"}
+    _KIND_CODE = {"RequestVote": 1, "Vote": 2, "Heartbeat": 3}
+
+    def __init__(self, server_count: int):
+        self.n = server_count
+        self.send_capacity = server_count
+
+    # -- host <-> packed ---------------------------------------------------
+
+    _ROLE_CODE = {FOLLOWER: 0, CANDIDATE: 1, LEADER: 2}
+    _ROLE_NAME = {0: FOLLOWER, 1: CANDIDATE, 2: LEADER}
+
+    def pack_actor_state(self, i, s: RaftState) -> np.ndarray:
+        votes = 0
+        for v in s.votes:
+            votes |= 1 << int(v)
+        return np.array(
+            [
+                self._ROLE_CODE[s.role],
+                s.term,
+                0 if s.voted_for is None else int(s.voted_for) + 1,
+                votes,
+            ],
+            np.uint32,
+        )
+
+    def unpack_actor_state(self, i, row) -> RaftState:
+        votes = int(row[3])
+        return RaftState(
+            role=self._ROLE_NAME[int(row[0])],
+            term=int(row[1]),
+            voted_for=None if int(row[2]) == 0 else Id(int(row[2]) - 1),
+            votes=frozenset(
+                Id(b) for b in range(self.n) if votes & (1 << b)
+            ),
+        )
+
+    def pack_msg(self, msg) -> np.ndarray:
+        return np.array([self._KIND_CODE[msg[0]], msg[1]], np.uint32)
+
+    def unpack_msg(self, vec):
+        return (self._KIND_NAME[int(vec[0])], int(vec[1]))
+
+    # -- traceable kernels -------------------------------------------------
+
+    def _no_sends(self):
+        import jax.numpy as jnp
+
+        return jnp.full((self.send_capacity, 1 + self.msg_width), self.SEND_NONE)
+
+    def _broadcast(self, me, kind, term):
+        """Sends (kind, term) to every peer of ``me``."""
+        import jax.numpy as jnp
+
+        n = self.n
+        ids = jnp.arange(n, dtype=jnp.uint32)
+        dst = jnp.where(ids == me.astype(jnp.uint32), self.SEND_NONE, ids)
+        kinds = jnp.full((n,), kind, jnp.uint32)
+        terms = jnp.full((n,), term, jnp.uint32)
+        return jnp.stack([dst, kinds, terms], axis=1)
+
+    def on_msg_branches(self, model):
+        import jax
+        import jax.numpy as jnp
+
+        n = self.n
+        maj = majority(n)
+        u = jnp.uint32
+
+        def on_msg(me, row, src, msg):
+            role, term, voted, votes = row[0], row[1], row[2], row[3]
+            kind, mterm = msg[0], msg[1]
+            srcu = src.astype(u)
+            src_bit = u(1) << srcu
+            no_sends = self._no_sends()
+            zero = u(0)
+
+            # --- RequestVote ---
+            newer = mterm > term
+            grant_same = (
+                (mterm == term)
+                & (role == 0)
+                & ((voted == 0) | (voted == srcu + 1))
+            )
+            rv_grant = newer | grant_same
+            rv_changed = newer | (grant_same & (voted != srcu + 1))
+            rv_row = jnp.stack(
+                [
+                    zero,
+                    jnp.where(newer, mterm, term),
+                    srcu + 1,
+                    jnp.where(newer, zero, votes),
+                ]
+            )
+            rv_row = jnp.where(rv_changed, rv_row, row)
+            # reply Vote(mterm) to src when granting
+            rv_sends = no_sends.at[0].set(
+                jnp.where(
+                    rv_grant,
+                    jnp.stack([srcu, u(self.K_VOTE), mterm]),
+                    no_sends[0],
+                )
+            )
+
+            # --- Vote ---
+            votes_new = votes | src_bit
+            is_cand = (role == 1) & (mterm == term)
+            wins = jax.lax.population_count(votes_new) >= maj
+            dup = votes == votes_new
+            v_changed = is_cand & ~dup
+            v_wins = is_cand & wins
+            v_row = jnp.stack(
+                [
+                    jnp.where(v_wins, u(2), u(1)),
+                    term,
+                    voted,
+                    votes_new,
+                ]
+            )
+            v_row = jnp.where(v_changed | v_wins, v_row, row)
+            v_sends = jnp.where(
+                v_wins, self._broadcast(me, u(self.K_HEARTBEAT), term), no_sends
+            )
+            v_cancel = jnp.where(v_wins, u(1), zero)
+
+            # --- Heartbeat ---
+            hb_live = mterm >= term
+            hb_same_follower = (role == 0) & (mterm == term)
+            hb_adopt = hb_live & ~hb_same_follower
+            hb_row = jnp.stack(
+                [
+                    zero,
+                    mterm,
+                    jnp.where(mterm == term, voted, zero),
+                    zero,
+                ]
+            )
+            hb_row = jnp.where(hb_adopt, hb_row, row)
+            hb_set = jnp.where(hb_live, u(1), zero)
+
+            is_rv = kind == self.K_REQUEST_VOTE
+            is_v = kind == self.K_VOTE
+            row_out = jnp.where(is_rv, rv_row, jnp.where(is_v, v_row, hb_row))
+            sends = jnp.where(is_rv, rv_sends, jnp.where(is_v, v_sends, no_sends))
+            set_bits = jnp.where(is_rv | is_v, zero, hb_set)
+            cancel_bits = jnp.where(is_v, v_cancel, zero)
+            changed = jnp.where(
+                is_rv, rv_changed, jnp.where(is_v, v_changed | v_wins, hb_adopt)
+            )
+            return row_out, sends, set_bits, cancel_bits, changed
+
+        return [on_msg]
+
+    def on_timeout_branches(self, model):
+        import jax
+        import jax.numpy as jnp
+
+        n = self.n
+        maj = majority(n)
+        u = jnp.uint32
+
+        def on_timeout(me, row, bit):
+            term1 = row[1] + 1
+            meu = me.astype(u)
+            votes1 = u(1) << meu
+            wins = jax.lax.population_count(votes1) >= maj  # single-node only
+            row_out = jnp.stack(
+                [jnp.where(wins, u(2), u(1)), term1, meu + 1, votes1]
+            )
+            sends = jnp.where(
+                wins,
+                self._no_sends(),
+                self._broadcast(me, u(self.K_REQUEST_VOTE), term1),
+            )
+            # Host: set_timer first, cancel on self-election — cancel wins.
+            set_bits = u(1)
+            cancel_bits = jnp.where(wins, u(1), u(0))
+            return row_out, sends, set_bits, cancel_bits, jnp.bool_(True)
+
+        return [on_timeout]
+
+    # -- traceable model hooks ---------------------------------------------
+
+    def packed_conditions(self, model):
+        import jax.numpy as jnp
+
+        n = self.n
+
+        def election_safety(state):
+            role = state["rows"][:, 0]
+            term = state["rows"][:, 1]
+            lead = role == 2
+            pair = (
+                lead[:, None]
+                & lead[None, :]
+                & (term[:, None] == term[None, :])
+                & (jnp.arange(n)[:, None] < jnp.arange(n)[None, :])
+            )
+            return ~pair.any()
+
+        def leader_elected(state):
+            return (state["rows"][:, 0] == 2).any()
+
+        return [election_safety, leader_elected, leader_elected]
+
+    def packed_within_boundary(self, model, state):
+        return (state["rows"][:, 1] <= model.cfg.max_term).all()
+
+
 @dataclass
 class RaftModelCfg:
     server_count: int = 5
@@ -166,9 +389,15 @@ class RaftModelCfg:
     )
 
     def into_model(self) -> ActorModel:
-        model = ActorModel(cfg=self, init_history=None)
-        for i in range(self.server_count):
-            model.actor(RaftActor(model_peers(i, self.server_count)))
+        n = self.server_count
+        model = PackedActorModel(
+            codec=RaftPackedCodec(n), cfg=self, init_history=None
+        )
+        # Distinct-envelope upper bound: 3 message kinds × directed pairs ×
+        # live terms (boundary-pruned states keep message terms ≤ max_term).
+        model.with_envelope_capacity(max(8, 3 * n * (n - 1) * self.max_term))
+        for i in range(n):
+            model.actor(RaftActor(model_peers(i, n)))
 
         def election_safety(_model, state):
             leaders = [

@@ -40,13 +40,14 @@ async function refreshStatus() {
       `depth=${s.max_depth}${s.done ? " (done)" : ""}`;
     const items = s.properties.map((p) => {
       let extra = "";
+      // Discoveries refute "always"/"eventually" (counterexamples) and
+      // witness "sometimes" (examples).
+      const refutes = p.expectation === "always" || p.expectation === "eventually";
       if (p.discovery) {
-        const kind = p.expectation === "always" ? "counterexample" : "example";
+        const kind = refutes ? "counterexample" : "example";
         extra = ` <a href="#" class="discovery" data-fps="${esc(p.discovery.fingerprints)}">${kind}</a>`;
       }
-      const status = p.discovery
-        ? (p.expectation === "always" ? "violated" : "witnessed")
-        : "pending";
+      const status = p.discovery ? (refutes ? "violated" : "witnessed") : "pending";
       return `<li>${badge(status)} <b>${esc(p.expectation)}</b> ${esc(p.name)}${extra}</li>`;
     });
     $("properties").innerHTML = items.join("");

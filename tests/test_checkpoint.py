@@ -43,11 +43,16 @@ def test_resume_completes_the_space(tmp_path):
 
 
 def test_resume_rejects_non_batchable_model(tmp_path):
-    from stateright_tpu.models.raft import RaftModelCfg
+    from stateright_tpu import FnModel
 
-    checker = RaftModelCfg(server_count=3, max_term=1).into_model().checker()
+    def fn(prev, out):
+        if prev is None:
+            out.append(0)
+
     with pytest.raises(TypeError):
-        checker.spawn_tpu_bfs(resume_from=str(tmp_path / "nope.ckpt"))
+        FnModel(fn).checker().spawn_tpu_bfs(
+            resume_from=str(tmp_path / "nope.ckpt")
+        )
 
 
 def test_resume_rejects_differently_configured_model(tmp_path):

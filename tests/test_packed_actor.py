@@ -1,0 +1,125 @@
+"""Packed actor-system parity: device checkers == host checkers, exactly.
+
+The packed ``ActorModel`` machinery (``stateright_tpu.actor.packed``) stages
+deliver/drop/timeout transitions into fixed-width kernels; these tests pin
+exact state-count agreement with the host model across network semantics —
+the framework's core correctness contract (SURVEY §4 layer 3).
+"""
+
+import pytest
+
+from stateright_tpu.actor import Network
+from stateright_tpu.models.raft import LEADER, RaftModelCfg
+
+
+def _tpu(cfg, **kw):
+    checker = (
+        cfg.into_model()
+        .checker()
+        .spawn_tpu_bfs(frontier_capacity=256, table_capacity=1 << 14, **kw)
+        .join()
+    )
+    assert checker.worker_error() is None
+    return checker
+
+
+def test_pack_unpack_round_trip():
+    model = RaftModelCfg(server_count=3, max_term=1).into_model()
+    init = model.init_states()[0]
+    assert model.unpack_state(model.pack_state(init)) == init
+
+
+def test_parity_lossless_duplicating():
+    cfg = RaftModelCfg(
+        server_count=3,
+        max_term=1,
+        lossy=False,
+        network=Network.new_unordered_duplicating(),
+    )
+    assert _tpu(cfg).unique_state_count() == 53
+
+
+def test_parity_lossy_duplicating():
+    cfg = RaftModelCfg(
+        server_count=3,
+        max_term=1,
+        lossy=True,
+        network=Network.new_unordered_duplicating(),
+    )
+    assert _tpu(cfg).unique_state_count() == 2717
+
+
+def test_parity_lossy_nonduplicating():
+    cfg = RaftModelCfg(server_count=3, max_term=1, lossy=True)
+    assert _tpu(cfg).unique_state_count() == 665
+
+
+def test_parity_on_sharded_mesh():
+    checker = (
+        RaftModelCfg(server_count=3, max_term=1, lossy=True)
+        .into_model()
+        .checker()
+        .spawn_sharded_tpu_bfs(frontier_per_device=64)
+        .join()
+    )
+    assert checker.worker_error() is None
+    assert checker.unique_state_count() == 665
+
+
+def test_device_discoveries_replay_and_are_meaningful():
+    checker = _tpu(RaftModelCfg(server_count=3, max_term=1, lossy=True))
+    paths = checker.discoveries()
+    assert set(paths) == {"leader elected", "stable leader"}
+    elected = paths["leader elected"].last_state()
+    assert any(s.role == LEADER for s in elected.actor_states)
+    stuck = paths["stable leader"].last_state()
+    assert not any(s.role == LEADER for s in stuck.actor_states)
+
+
+def test_tpu_simulation_runs_packed_actor_system():
+    checker = (
+        RaftModelCfg(server_count=3, max_term=1, lossy=False)
+        .into_model()
+        .checker()
+        .target_state_count(20_000)
+        .spawn_tpu_simulation(seed=3, lanes=128, steps_per_call=16)
+        .join()
+    )
+    assert checker.worker_error() is None
+    paths = checker.discoveries()
+    if "leader elected" in paths:
+        final = paths["leader elected"].last_state()
+        assert any(s.role == LEADER for s in final.actor_states)
+
+
+class TestPackedGuardrails:
+    def test_crashes_unsupported(self):
+        cfg = RaftModelCfg(server_count=3, max_term=1, max_crashes=1)
+        with pytest.raises(RuntimeError):
+            _tpu(cfg)
+
+    def test_ordered_network_unsupported(self):
+        cfg = RaftModelCfg(
+            server_count=3, max_term=1, network=Network.new_ordered()
+        )
+        with pytest.raises(RuntimeError):
+            _tpu(cfg)
+
+    def test_host_checking_still_works_for_unsupported_configs(self):
+        # The same PackedActorModel object remains a plain ActorModel: host
+        # checkers handle what the packed path refuses.
+        cfg = RaftModelCfg(server_count=3, max_term=1, max_crashes=1)
+        checker = cfg.into_model().checker().spawn_bfs().join()
+        assert "election safety" not in checker.discoveries()
+
+    def test_undersized_envelope_capacity_is_caught_by_counts(self):
+        model = (
+            RaftModelCfg(server_count=3, max_term=1, lossy=True)
+            .into_model()
+            .with_envelope_capacity(2)  # far below the reachable bound
+        )
+        checker = model.checker().spawn_tpu_bfs(frontier_capacity=128).join()
+        assert checker.worker_error() is None
+        # Overflowing transitions were pruned: counts fall short of the
+        # host oracle, which is how parity tests surface a bad capacity.
+        assert checker.unique_state_count() < 665
