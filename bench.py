@@ -16,20 +16,45 @@ Diagnostics go to stderr; stdout carries only the JSON line.
 from __future__ import annotations
 
 import json
+import subprocess
 import sys
 import time
 
 RM_COUNT = 7
 EXPECTED_UNIQUE = 296_448
 HOST_CAP = 30_000
+DEVICE_PROBE_TIMEOUT_S = 300
 
 
 def log(*args):
     print(*args, file=sys.stderr, flush=True)
 
 
+def _accelerator_usable() -> bool:
+    """Probes device init in a subprocess: a wedged device tunnel hangs
+    ``jax.devices()`` indefinitely, which must not hang the bench."""
+    code = "import jax; d = jax.devices(); print('probe-ok', d[0].platform)"
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", code],
+            timeout=DEVICE_PROBE_TIMEOUT_S,
+            capture_output=True,
+        )
+    except subprocess.TimeoutExpired:
+        log(f"device probe timed out after {DEVICE_PROBE_TIMEOUT_S}s")
+        return False
+    ok = b"probe-ok" in r.stdout
+    if not ok:
+        log(f"device probe failed: {r.stderr[-500:]!r}")
+    return ok
+
+
 def main():
     import jax
+
+    if not _accelerator_usable():
+        log("falling back to CPU backend")
+        jax.config.update("jax_platforms", "cpu")
 
     from stateright_tpu.models.two_phase_commit import TwoPhaseSys
 

@@ -42,6 +42,7 @@ import numpy as np
 from ..core.batch import BatchableModel
 from ..core.model import Expectation
 from ..core.path import Path
+from ..native import make_fingerprint_store
 from ..ops.fingerprint import fingerprint_state, fp_to_int
 from ..ops.hashset import hashset_insert, hashset_new
 from .base import Checker
@@ -114,9 +115,11 @@ class TpuBfsChecker(Checker):
         self._unique_count = 0
         self._max_depth = 0
         self._discoveries_fp: Dict[str, int] = {}
-        # (child fps u64, parent fps u64 — 0 encodes "init state") per wave.
+        # (child fps u64, parent fps u64 — 0 encodes "init state") per wave,
+        # ingested into the native parent-pointer store (C++ open-addressing
+        # map; see stateright_tpu.native) for path reconstruction.
         self._wave_log: List = []
-        self._parent_map: Dict[int, Optional[int]] = {}
+        self._store = make_fingerprint_store()
         self._ingested = 0
         self._ingest_lock = threading.Lock()
         self._done_event = threading.Event()
@@ -446,14 +449,7 @@ class TpuBfsChecker(Checker):
         import pickle
 
         self._ingest_wave_log()
-        children = np.fromiter(
-            self._parent_map.keys(), dtype=np.uint64, count=len(self._parent_map)
-        )
-        parents = np.fromiter(
-            (p or 0 for p in self._parent_map.values()),
-            dtype=np.uint64,
-            count=len(self._parent_map),
-        )
+        children, parents = self._store.export()
         payload = {
             "version": 1,
             "model": type(self._model).__name__,
@@ -558,22 +554,17 @@ class TpuBfsChecker(Checker):
 
     def _ingest_wave_log(self):
         # Raced by the worker (visitor reconstruction) and the user thread
-        # (mid-run discoveries()); must not skip a wave.
+        # (mid-run discoveries()); must not skip a wave. First-writer-wins
+        # inserts keep the shortest-path parent.
         with self._ingest_lock:
             while self._ingested < len(self._wave_log):
                 children, parents = self._wave_log[self._ingested]
-                for c, p in zip(children.tolist(), parents.tolist()):
-                    if c not in self._parent_map:
-                        self._parent_map[c] = p if p else None
+                self._store.insert_batch(children, parents)
                 self._ingested += 1
 
     def _reconstruct(self, fp: int) -> Path:
         self._ingest_wave_log()
-        chain: deque = deque()
-        cur: Optional[int] = fp
-        while cur is not None:
-            chain.appendleft(cur)
-            cur = self._parent_map.get(cur)
+        chain = self._store.chain(fp)
         return Path.from_fingerprints(self._model, chain, fp_of=self._host_fp)
 
     # -- Checker surface ---------------------------------------------------

@@ -47,6 +47,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..core.batch import BatchableModel
 from ..core.model import Expectation
 from ..core.path import Path
+from ..native import make_fingerprint_store
 from ..ops.fingerprint import fingerprint_state, fp_to_int
 from ..ops.hashset import hashset_insert, hashset_new
 from .base_mesh import default_mesh
@@ -136,7 +137,7 @@ class ShardedTpuBfsChecker(Checker):
         self._max_depth = 0
         self._discoveries_fp: Dict[str, int] = {}
         self._wave_log: List = []
-        self._parent_map: Dict[int, Optional[int]] = {}
+        self._store = make_fingerprint_store()
         self._ingested = 0
         self._ingest_lock = threading.Lock()
         self._done_event = threading.Event()
@@ -597,18 +598,12 @@ class ShardedTpuBfsChecker(Checker):
         with self._ingest_lock:
             while self._ingested < len(self._wave_log):
                 children, parents = self._wave_log[self._ingested]
-                for c, p in zip(children.tolist(), parents.tolist()):
-                    if c not in self._parent_map:
-                        self._parent_map[c] = p if p else None
+                self._store.insert_batch(children, parents)
                 self._ingested += 1
 
     def _reconstruct(self, fp: int) -> Path:
         self._ingest_wave_log()
-        chain: deque = deque()
-        cur: Optional[int] = fp
-        while cur is not None:
-            chain.appendleft(cur)
-            cur = self._parent_map.get(cur)
+        chain = self._store.chain(fp)
         return Path.from_fingerprints(self._model, chain, fp_of=self._host_fp)
 
     # -- Checker surface ---------------------------------------------------

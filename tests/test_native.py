@@ -1,0 +1,90 @@
+"""Native fingerprint store: C++/Python implementations agree exactly."""
+
+import numpy as np
+import pytest
+
+from stateright_tpu.native import (
+    NativeFingerprintStore,
+    PyFingerprintStore,
+    make_fingerprint_store,
+)
+
+
+@pytest.fixture(params=["python", "native"])
+def store(request):
+    if request.param == "python":
+        return PyFingerprintStore()
+    try:
+        return NativeFingerprintStore(64)
+    except RuntimeError:
+        pytest.skip("toolchain unavailable")
+
+
+class TestFingerprintStore:
+    def test_insert_first_writer_wins(self, store):
+        c = np.array([10, 20, 10], np.uint64)
+        p = np.array([0, 10, 99], np.uint64)
+        assert store.insert_batch(c, p) == 2
+        assert store.parent(10) is None  # first write (root) won
+        assert store.parent(20) == 10
+
+    def test_chain_walks_to_root(self, store):
+        store.insert_batch(
+            np.array([1, 2, 3], np.uint64), np.array([0, 1, 2], np.uint64)
+        )
+        assert store.chain(3) == [1, 2, 3]
+        assert store.chain(1) == [1]
+        with pytest.raises(KeyError):
+            store.chain(42)
+
+    def test_chain_with_dangling_parent_terminates(self, store):
+        # Parent 1 was never inserted: the chain ends at it but includes it
+        # (both implementations must agree).
+        store.insert_batch(np.array([2], np.uint64), np.array([1], np.uint64))
+        assert store.chain(2) == [1, 2]
+
+    def test_membership_and_len(self, store):
+        store.insert_batch(np.array([5], np.uint64), np.array([0], np.uint64))
+        assert 5 in store and 6 not in store
+        assert len(store) == 1
+
+    def test_export_round_trips(self, store):
+        c = np.array([7, 8, 9], np.uint64)
+        p = np.array([0, 7, 7], np.uint64)
+        store.insert_batch(c, p)
+        ch, pa = store.export()
+        pairs = dict(zip(ch.tolist(), pa.tolist()))
+        assert pairs == {7: 0, 8: 7, 9: 7}
+
+
+def test_native_store_builds_and_grows():
+    try:
+        s = NativeFingerprintStore(64)
+    except RuntimeError:
+        pytest.skip("toolchain unavailable")
+    rng = np.random.default_rng(7)
+    keys = rng.integers(1, 2**63, size=200_000, dtype=np.uint64)
+    parents = np.zeros_like(keys)
+    fresh = s.insert_batch(keys, parents)
+    assert fresh == len(np.unique(keys))
+    assert len(s) == fresh
+
+
+def test_factory_prefers_native():
+    store = make_fingerprint_store()
+    # On this image the toolchain exists, so the native store must load.
+    assert type(store).__name__ == "NativeFingerprintStore"
+
+
+def test_device_checkers_use_store_for_paths():
+    from stateright_tpu.models.two_phase_commit import TwoPhaseSys
+
+    checker = (
+        TwoPhaseSys(3)
+        .checker()
+        .spawn_tpu_bfs(frontier_capacity=64)
+        .join()
+    )
+    assert checker.worker_error() is None
+    for path in checker.discoveries().values():
+        assert len(path) >= 1
