@@ -254,6 +254,20 @@ class TpuBfsChecker(Checker):
             out["prop_hit"] = jnp.stack(hits)
             out["prop_hi"] = jnp.stack(fhis)
             out["prop_lo"] = jnp.stack(flos)
+        # One consolidated scalar vector: each np.asarray() pull through the
+        # device tunnel costs a round trip, so the host loop reads counters
+        # (and property-hit flags) in a single transfer per wave.
+        stats = [
+            generated,
+            n_new,
+            pending.sum(dtype=jnp.int32),
+            jnp.max(jnp.where(mask, depth, 0)),
+        ]
+        if self._properties:
+            stats.append(out["prop_hit"].any().astype(jnp.int32))
+        out["stats"] = jnp.stack(
+            [s.astype(jnp.int32) for s in stats]
+        )
         return out
 
     def _take(self, arrs, start, size):
