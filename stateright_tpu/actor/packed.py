@@ -8,23 +8,27 @@ cannot be traced. This module provides the fixed-width staged equivalent
 staged transition function"):
 
 - **actor rows**: per-actor state packs into a ``(N, R)`` u32 matrix;
-- **network table**: a bounded ``(E,)``-slot envelope table (src, dst,
-  msg words, count) kept *canonically sorted* so identical envelope
-  multisets produce identical arrays (the host hashes networks
-  order-insensitively; sorting is the device analog);
+- **network table**: unordered nets use a bounded ``(E,)``-slot envelope
+  table (src, dst, msg words, count) kept *canonically sorted* so identical
+  envelope multisets produce identical arrays (the host hashes networks
+  order-insensitively; sorting is the device analog); ordered nets use per
+  directed-pair FIFO queues ``(N², Q, W)`` with the head always at index 0
+  (shift-on-consume keeps the arrays canonical) — the device analog of the
+  reference's ``BTreeMap<(src,dst), VecDeque>`` flows
+  (``/root/reference/src/actor/network.rs:46-68``);
 - **timers**: one bitmask word per actor;
-- **dense actions**: ``E`` Deliver ids + ``E`` Drop ids (lossy only) +
-  ``N×T`` Timeout ids, each with a traceable guard;
+- **crash faults**: a ``(N,)`` crashed vector when ``max_crashes`` is set;
+  excluded from fingerprints via ``packed_fingerprint_view`` to mirror the
+  host state hash (reference ``src/actor/model_state.rs:86-97``);
+- **dense actions**: Deliver ids (``E`` slots, or ``N²`` flow heads for
+  ordered) + Drop ids (lossy only) + ``N×T`` Timeout ids + ``N`` Crash ids
+  (when ``max_crashes > 0``), each with a traceable guard;
+- **auxiliary history**: codecs with ``history_width > 0`` carry a packed
+  history vector updated by traceable ``record_msg_in``/``record_msg_out``
+  twins (see ``semantics/packed_linearizability.py``);
 - **actor callbacks**: each actor type supplies jax-traceable
   ``on_msg``/``on_timeout`` kernels via an ``ActorPackedCodec``;
   heterogeneous systems dispatch with ``lax.switch``.
-
-Parity-scoped v1 (each limit raises loudly, host checkers remain available
-for the rest): unordered networks only (ordered FIFO flows need ring
-buffers), no auxiliary history (``LinearizabilityTester`` histories are
-host-only by design — SURVEY §7 hard parts), and no crash faults (the host
-state hash deliberately excludes ``crashed``, which device fingerprints
-cannot reproduce without aliasing distinct live states).
 
 The transition semantics mirror the host model exactly — no-op pruning
 (``is_no_op``/``is_no_op_with_timer``), deliver-before-send network
@@ -34,7 +38,7 @@ host checkers agree on exact state counts (the parity test contract).
 
 from __future__ import annotations
 
-from typing import Any, Callable, List, Optional
+from typing import Any, Callable, List, Optional, Sequence
 
 import numpy as np
 
@@ -70,8 +74,18 @@ class ActorPackedCodec:
 
     msg_width: int
     state_width: int
-    timer_values: List[Any]  # timer value -> bit index by position
+    # timer value -> bit index by position; immutable default (a mutable
+    # class-level list would be shared across every codec subclass).
+    timer_values: Sequence[Any] = ()
     send_capacity: int
+    # Auxiliary history support (the reference's ``H`` type param,
+    # ``/root/reference/src/actor/model.rs:23-55``): 0 means "no history".
+    # A codec with ``history_width > 0`` packs the model's history into a
+    # ``(history_width,)`` u32 vector that rides in the packed state (and
+    # therefore the device fingerprint — history distinguishes states, so
+    # it must), and supplies the two traceable hooks mirroring the host's
+    # ``record_msg_in`` / ``record_msg_out``.
+    history_width: int = 0
 
     # -- host <-> packed conversions --------------------------------------
 
@@ -87,6 +101,24 @@ class ActorPackedCodec:
     def unpack_msg(self, vec: np.ndarray):
         raise NotImplementedError
 
+    def pack_history(self, history) -> np.ndarray:
+        raise NotImplementedError
+
+    def unpack_history(self, vec: np.ndarray):
+        raise NotImplementedError
+
+    # -- traceable history hooks (history_width > 0 only) -------------------
+
+    def history_on_deliver(self, model, hist, src, dst, msg):
+        """``record_msg_in`` analog: applied on Deliver with the envelope
+        being delivered, BEFORE send commands are processed (host order)."""
+        raise NotImplementedError
+
+    def history_on_send(self, model, hist, src, dst, msg):
+        """``record_msg_out`` analog: applied per Send command, in command
+        order, to the already-updated history."""
+        raise NotImplementedError
+
     # -- traceable kernels -------------------------------------------------
 
     def actor_type_id(self, actor_index: int, actor) -> int:
@@ -96,7 +128,8 @@ class ActorPackedCodec:
         raise NotImplementedError
 
     def on_timeout_branches(self, model) -> List[Callable]:
-        raise NotImplementedError
+        """Timer-free codecs (empty ``timer_values``) may return []."""
+        return []
 
     # -- traceable model hooks ---------------------------------------------
 
@@ -122,31 +155,29 @@ class PackedActorModel(ActorModel, BatchableModel):
         super().__init__(cfg=cfg, init_history=init_history)
         self.codec = codec
         self.envelope_capacity = 32
+        self.flow_capacity = 8
 
     def with_envelope_capacity(self, capacity: int) -> "PackedActorModel":
-        """Sets the network table's slot count. Must upper-bound the
-        reachable distinct-envelope count: overflowing transitions are
-        pruned, which the exact-count parity tests surface as a mismatch."""
+        """Sets the network table's slot count (unordered networks). Must
+        upper-bound the reachable distinct-envelope count: overflowing
+        transitions are pruned, which the exact-count parity tests surface
+        as a mismatch."""
         self.envelope_capacity = capacity
+        return self
+
+    def with_flow_capacity(self, capacity: int) -> "PackedActorModel":
+        """Sets the per-flow FIFO depth (ordered networks); analogous
+        overflow semantics to ``with_envelope_capacity``."""
+        self.flow_capacity = capacity
         return self
 
     # -- validation --------------------------------------------------------
 
     def _packed_check(self):
-        if self.init_history is not None:
+        if self.init_history is not None and not self.codec.history_width:
             raise NotImplementedError(
-                "packed actor systems do not support auxiliary history "
-                "(consistency-tester properties evaluate on the host path)"
-            )
-        if self._max_crashes:
-            raise NotImplementedError(
-                "packed actor systems do not support crash faults (the host "
-                "state hash excludes `crashed`, which device fingerprints "
-                "cannot mirror)"
-            )
-        if self._init_network.kind == ORDERED:
-            raise NotImplementedError(
-                "packed actor systems support unordered networks only"
+                "this codec does not pack auxiliary history (declare "
+                "history_width and the history hooks to stage it on device)"
             )
         if len(self._init_network.data):
             raise NotImplementedError(
@@ -164,12 +195,25 @@ class PackedActorModel(ActorModel, BatchableModel):
         return self.envelope_capacity
 
     @property
+    def _Q(self) -> int:
+        return self.flow_capacity
+
+    @property
+    def _P(self) -> int:
+        """Directed flow pairs (ordered networks): ``src * N + dst``."""
+        return self._N * self._N
+
+    @property
     def _T(self) -> int:
         return len(self.codec.timer_values)
 
     @property
     def _dup(self) -> bool:
         return self._init_network.kind == UNORDERED_DUPLICATING
+
+    @property
+    def _ordered(self) -> bool:
+        return self._init_network.kind == ORDERED
 
     def _timer_bit(self, timer) -> int:
         return self.codec.timer_values.index(timer)
@@ -178,8 +222,10 @@ class PackedActorModel(ActorModel, BatchableModel):
 
     def packed_action_count(self) -> int:
         self._packed_check()
-        deliver_drop = self._E * (2 if self._lossy_network else 1)
-        return deliver_drop + self._N * self._T
+        slots = self._P if self._ordered else self._E
+        deliver_drop = slots * (2 if self._lossy_network else 1)
+        crash = self._N if self._max_crashes else 0
+        return deliver_drop + self._N * self._T + crash
 
     # -- host <-> packed state conversion ----------------------------------
 
@@ -195,43 +241,79 @@ class PackedActorModel(ActorModel, BatchableModel):
             for t in tset:
                 timers[i] |= np.uint32(1) << np.uint32(self._timer_bit(t))
 
-        envs = []
-        if self._init_network.kind == UNORDERED_NONDUPLICATING:
-            items = list(sys_state.network.data.items())
+        if self._ordered:
+            Q, P = self._Q, self._P
+            flow_msg = np.zeros((P, Q, W), np.uint32)
+            flow_len = np.zeros((P,), np.uint32)
+            for (src, dst), msgs in sys_state.network.data.items():
+                if len(msgs) > Q:
+                    raise ValueError(
+                        f"flow {src!r}->{dst!r} holds {len(msgs)} messages; "
+                        f"flow_capacity={Q} is too small"
+                    )
+                p = int(src) * N + int(dst)
+                flow_len[p] = len(msgs)
+                for i, m in enumerate(msgs):
+                    flow_msg[p, i] = codec.pack_msg(m)
+            out = {
+                "rows": rows,
+                "timers": timers,
+                "flow_msg": flow_msg,
+                "flow_len": flow_len,
+            }
         else:
-            items = [(env, 1) for env in sys_state.network.data]
-        if len(items) > E:
-            raise ValueError(
-                f"state has {len(items)} distinct envelopes; "
-                f"envelope_capacity={E} is too small"
-            )
-        for env, count in items:
-            envs.append(
-                (
-                    int(env.src),
-                    int(env.dst),
-                    tuple(int(x) for x in codec.pack_msg(env.msg)),
-                    int(count),
+            envs = []
+            if self._init_network.kind == UNORDERED_NONDUPLICATING:
+                items = list(sys_state.network.data.items())
+            else:
+                items = [(env, 1) for env in sys_state.network.data]
+            if len(items) > E:
+                raise ValueError(
+                    f"state has {len(items)} distinct envelopes; "
+                    f"envelope_capacity={E} is too small"
                 )
+            for env, count in items:
+                envs.append(
+                    (
+                        int(env.src),
+                        int(env.dst),
+                        tuple(int(x) for x in codec.pack_msg(env.msg)),
+                        int(count),
+                    )
+                )
+            envs.sort()
+            net_src = np.zeros((E,), np.uint32)
+            net_dst = np.zeros((E,), np.uint32)
+            net_msg = np.zeros((E, W), np.uint32)
+            net_cnt = np.zeros((E,), np.uint32)
+            for slot, (src, dst, msg, count) in enumerate(envs):
+                net_src[slot] = src
+                net_dst[slot] = dst
+                net_msg[slot] = msg
+                net_cnt[slot] = count
+            out = {
+                "rows": rows,
+                "timers": timers,
+                "net_src": net_src,
+                "net_dst": net_dst,
+                "net_msg": net_msg,
+                "net_cnt": net_cnt,
+            }
+        if self._max_crashes:
+            out["crashed"] = np.array(
+                [1 if c else 0 for c in sys_state.crashed], np.uint32
             )
-        envs.sort()
-        net_src = np.zeros((E,), np.uint32)
-        net_dst = np.zeros((E,), np.uint32)
-        net_msg = np.zeros((E, W), np.uint32)
-        net_cnt = np.zeros((E,), np.uint32)
-        for slot, (src, dst, msg, count) in enumerate(envs):
-            net_src[slot] = src
-            net_dst[slot] = dst
-            net_msg[slot] = msg
-            net_cnt[slot] = count
-        return {
-            "rows": rows,
-            "timers": timers,
-            "net_src": net_src,
-            "net_dst": net_dst,
-            "net_msg": net_msg,
-            "net_cnt": net_cnt,
-        }
+        if codec.history_width:
+            hist = np.asarray(
+                codec.pack_history(sys_state.history), np.uint32
+            )
+            if hist.shape != (codec.history_width,):
+                raise ValueError(
+                    f"pack_history returned shape {hist.shape}; expected "
+                    f"({codec.history_width},)"
+                )
+            out["hist"] = hist
+        return out
 
     def unpack_state(self, packed) -> ActorModelState:
         codec = self.codec
@@ -248,25 +330,41 @@ class PackedActorModel(ActorModel, BatchableModel):
                     tset.set(timer)
             timers_set.append(tset)
         network = self._init_network.copy()
-        cnt = np.asarray(packed["net_cnt"])
-        src = np.asarray(packed["net_src"])
-        dst = np.asarray(packed["net_dst"])
-        msg = np.asarray(packed["net_msg"])
-        for slot in range(self._E):
-            if int(cnt[slot]):
-                env = Envelope(
-                    src=Id(int(src[slot])),
-                    dst=Id(int(dst[slot])),
-                    msg=codec.unpack_msg(msg[slot]),
-                )
-                for _ in range(int(cnt[slot])):
-                    network.send(env)
+        if self._ordered:
+            flow_msg = np.asarray(packed["flow_msg"])
+            flow_len = np.asarray(packed["flow_len"])
+            for p in range(self._P):
+                src, dst = Id(p // self._N), Id(p % self._N)
+                for i in range(int(flow_len[p])):
+                    network.send(
+                        Envelope(src=src, dst=dst, msg=codec.unpack_msg(flow_msg[p, i]))
+                    )
+        else:
+            cnt = np.asarray(packed["net_cnt"])
+            src = np.asarray(packed["net_src"])
+            dst = np.asarray(packed["net_dst"])
+            msg = np.asarray(packed["net_msg"])
+            for slot in range(self._E):
+                if int(cnt[slot]):
+                    env = Envelope(
+                        src=Id(int(src[slot])),
+                        dst=Id(int(dst[slot])),
+                        msg=codec.unpack_msg(msg[slot]),
+                    )
+                    for _ in range(int(cnt[slot])):
+                        network.send(env)
+        history = None
+        if codec.history_width:
+            history = codec.unpack_history(np.asarray(packed["hist"]))
+        crashed = [False] * self._N
+        if self._max_crashes:
+            crashed = [bool(c) for c in np.asarray(packed["crashed"])]
         return ActorModelState(
             actor_states=actor_states,
             network=network,
             timers_set=timers_set,
-            crashed=[False] * self._N,
-            history=None,
+            crashed=crashed,
+            history=history,
         )
 
     def packed_init_states(self):
@@ -281,13 +379,23 @@ class PackedActorModel(ActorModel, BatchableModel):
 
     # -- traceable transition ----------------------------------------------
 
+    def packed_fingerprint_view(self, state):
+        """Crash flags are excluded from the fingerprint, mirroring the
+        host state hash (reference ``src/actor/model_state.rs:86-97``)."""
+        if "crashed" not in state:
+            return state
+        return {k: v for k, v in state.items() if k != "crashed"}
+
     def _canonicalize(self, state):
         """Zeroes empty slots and sorts the envelope table so equal
         multisets produce identical arrays (device analog of the host's
-        order-insensitive network hash)."""
+        order-insensitive network hash). Ordered flows are positionally
+        canonical already (head always at slot 0)."""
         import jax
         import jax.numpy as jnp
 
+        if self._ordered:
+            return state
         W = self.codec.msg_width
         cnt = state["net_cnt"]
         empty = cnt == 0
@@ -303,19 +411,33 @@ class PackedActorModel(ActorModel, BatchableModel):
         src, dst = out[1], out[2]
         msg = jnp.stack(out[3 : 3 + W], axis=1) if W else msg
         cnt = out[3 + W]
-        return {
-            "rows": state["rows"],
-            "timers": state["timers"],
-            "net_src": src,
-            "net_dst": dst,
-            "net_msg": msg,
-            "net_cnt": cnt,
-        }
+        state = dict(state)  # extra keys (e.g. "hist") pass through untouched
+        state.update(
+            net_src=src, net_dst=dst, net_msg=msg, net_cnt=cnt
+        )
+        return state
 
     def _net_send(self, state, src, dst, msg, active):
         """One network send (host ``Network.send``): duplicating nets dedup,
-        non-duplicating nets count. Returns (state, overflow)."""
+        non-duplicating nets count, ordered nets append to the (src, dst)
+        FIFO. Returns (state, overflow)."""
         import jax.numpy as jnp
+
+        if self._ordered:
+            Q = self._Q
+            p = src.astype(jnp.int32) * self._N + dst.astype(jnp.int32)
+            p = jnp.clip(p, 0, self._P - 1)
+            length = state["flow_len"][p]
+            ok = active & (length < Q)
+            at = jnp.clip(length, 0, Q - 1).astype(jnp.int32)
+            state = dict(state)
+            state["flow_msg"] = state["flow_msg"].at[p, at].set(
+                jnp.where(ok, msg, state["flow_msg"][p, at])
+            )
+            state["flow_len"] = state["flow_len"].at[p].add(
+                jnp.where(ok, jnp.uint32(1), jnp.uint32(0))
+            )
+            return state, active & (length >= Q)
 
         src = src.astype(jnp.uint32)
         dst = dst.astype(jnp.uint32)
@@ -376,6 +498,13 @@ class PackedActorModel(ActorModel, BatchableModel):
             state, ov = self._net_send(
                 state, state_src(actor), dst, msg, active
             )
+            if self.codec.history_width:
+                # Host: each Send runs record_msg_out on the running history
+                # (sequential command processing, ``model.py:163-172``).
+                hist_new = self.codec.history_on_send(
+                    self, state["hist"], state_src(actor), dst, msg
+                )
+                state["hist"] = jnp.where(active, hist_new, state["hist"])
             overflow = overflow | ov
         return state, overflow
 
@@ -390,28 +519,72 @@ class PackedActorModel(ActorModel, BatchableModel):
         aid = action_id.astype(jnp.int32)
         msg_branches = codec.on_msg_branches(self)
         timeout_branches = codec.on_timeout_branches(self)
+        if not timeout_branches:
+            # Timer-free codec: lax.cond still traces the timeout arm, so
+            # substitute an inert branch (never selected — T == 0 means no
+            # timeout action ids exist).
+            def _inert(actor, row, bit):
+                z = jnp.uint32(0)
+                return (
+                    row,
+                    jnp.full(
+                        (codec.send_capacity, 1 + codec.msg_width),
+                        codec.SEND_NONE,
+                    ),
+                    z,
+                    z,
+                    jnp.bool_(False),
+                )
+
+            timeout_branches = [_inert] * max(1, len(msg_branches))
         type_ids = [
             codec.actor_type_id(i, a) for i, a in enumerate(self.actors_list)
         ]
         type_arr = jnp.asarray(type_ids, jnp.int32)
 
-        deliver_ids = E
-        drop_ids = E if lossy else 0
+        ordered = self._ordered
+        crashes = bool(self._max_crashes)
+        deliver_ids = self._P if ordered else E
+        drop_ids = deliver_ids if lossy else 0
+        timeout_ids = N * T
         is_deliver = aid < deliver_ids
         is_drop = lossy & (aid >= deliver_ids) & (aid < deliver_ids + drop_ids)
-        is_timeout = aid >= deliver_ids + drop_ids
+        is_timeout = (aid >= deliver_ids + drop_ids) & (
+            aid < deliver_ids + drop_ids + timeout_ids
+        )
+        is_crash = crashes & (aid >= deliver_ids + drop_ids + timeout_ids)
 
-        slot = jnp.clip(jnp.where(is_drop, aid - deliver_ids, aid), 0, E - 1)
-        tk = jnp.clip(aid - deliver_ids - drop_ids, 0, N * T - 1)
-        t_actor = tk // T
-        t_bit = (tk % T).astype(jnp.uint32)
+        slot = jnp.clip(
+            jnp.where(is_drop, aid - deliver_ids, aid), 0, deliver_ids - 1
+        )
+        # T == 0 (timer-free systems): no timeout action ids exist, so
+        # is_timeout is always false; T1 only keeps the index math traceable.
+        T1 = max(T, 1)
+        tk = jnp.clip(aid - deliver_ids - drop_ids, 0, N * T1 - 1)
+        t_actor = tk // T1
+        t_bit = (tk % T1).astype(jnp.uint32)
+        crash_actor = jnp.clip(
+            aid - deliver_ids - drop_ids - timeout_ids, 0, N - 1
+        )
 
-        cnt = state["net_cnt"]
-        present = cnt[slot] > 0
-        env_src = state["net_src"][slot].astype(jnp.int32)
-        env_dst = state["net_dst"][slot].astype(jnp.int32)
-        env_msg = state["net_msg"][slot]
+        if ordered:
+            flow_len = state["flow_len"]
+            present = flow_len[slot] > 0
+            env_src = slot // N
+            env_dst = slot % N
+            env_msg = state["flow_msg"][slot, 0]
+            cnt = None
+        else:
+            cnt = state["net_cnt"]
+            present = cnt[slot] > 0
+            env_src = state["net_src"][slot].astype(jnp.int32)
+            env_dst = state["net_dst"][slot].astype(jnp.int32)
+            env_msg = state["net_msg"][slot]
         dst_ok = env_dst < N
+        if crashes:
+            dst_crashed = state["crashed"][jnp.clip(env_dst, 0, N - 1)] == 1
+        else:
+            dst_crashed = jnp.bool_(False)
 
         # Which actor's callback runs (clamped for safety; masked by valid).
         actor = jnp.clip(jnp.where(is_timeout, t_actor, env_dst), 0, N - 1)
@@ -466,33 +639,89 @@ class PackedActorModel(ActorModel, BatchableModel):
         timer_set = (
             (state["timers"][t_actor] >> t_bit) & jnp.uint32(1)
         ) == 1
-        valid_deliver = is_deliver & present & dst_ok & ~is_no_op
+        # Ordered networks must consume no-op deliveries to preserve FIFO
+        # state (host ``model.py:246-249``); unordered prunes them.
+        deliver_effective = (
+            jnp.bool_(True) if ordered else ~is_no_op
+        )
+        valid_deliver = (
+            is_deliver & present & dst_ok & ~dst_crashed & deliver_effective
+        )
         valid_drop = is_drop & present
         valid_timeout = is_timeout & timer_set & ~renews_only
+        if crashes:
+            crash_count = state["crashed"].sum()
+            valid_crash = (
+                is_crash
+                & (crash_count < jnp.uint32(self._max_crashes))
+                & (state["crashed"][crash_actor] == 0)
+            )
+        else:
+            valid_crash = jnp.bool_(False)
 
         # -- build each outcome and select ----------------------------------
 
+        def consume_head(st):
+            """Removes the head of ordered flow ``slot`` (shift keeps the
+            queue canonical: head always at index 0)."""
+            st = dict(st)
+            q = st["flow_msg"][slot]
+            shifted = jnp.concatenate(
+                [q[1:], jnp.zeros((1, W), jnp.uint32)], axis=0
+            )
+            st["flow_msg"] = st["flow_msg"].at[slot].set(shifted)
+            st["flow_len"] = st["flow_len"].at[slot].add(
+                jnp.uint32(0) - 1
+            )
+            return st
+
         # Drop: duplicating removes the envelope entirely; counting nets
-        # decrement (host Network.on_drop).
-        drop_state = dict(state)
-        if self._dup:
-            drop_state["net_cnt"] = cnt.at[slot].set(jnp.uint32(0))
+        # decrement; ordered removes the flow head (host Network.on_drop).
+        if ordered:
+            drop_state = consume_head(state)
         else:
-            drop_state["net_cnt"] = cnt.at[slot].add(jnp.uint32(0) - 1)
+            drop_state = dict(state)
+            if self._dup:
+                drop_state["net_cnt"] = cnt.at[slot].set(jnp.uint32(0))
+            else:
+                drop_state["net_cnt"] = cnt.at[slot].add(jnp.uint32(0) - 1)
 
         # Deliver: network effect first (host: on_deliver before
-        # process_commands), then callback effects.
+        # process_commands), then callback effects. The record_msg_in analog
+        # applies to the PRE-send history (host: ``model.py:250-262``).
         deliver_state = dict(state)
-        if not self._dup:
+        if codec.history_width:
+            deliver_state["hist"] = codec.history_on_deliver(
+                self, state["hist"], env_src, env_dst, env_msg
+            )
+        if ordered:
+            deliver_state = consume_head(deliver_state)
+        elif not self._dup:
             deliver_state["net_cnt"] = cnt.at[slot].add(jnp.uint32(0) - 1)
+        # Ordered no-op deliveries consume the message but apply no other
+        # effect (the host skips the callback result entirely).
+        row_eff = jnp.where(is_no_op, state["rows"][actor], row_new)
+        no_send_buf = jnp.full_like(sends, codec.SEND_NONE)
+        sends_eff = jnp.where(is_no_op, no_send_buf, sends)
+        set_eff = jnp.where(is_no_op, jnp.uint32(0), set_bits)
+        cancel_eff = jnp.where(is_no_op, jnp.uint32(0), cancel_bits)
         deliver_state, ov_d = self._apply_callback(
-            deliver_state, actor, row_new, sends, set_bits, cancel_bits
+            deliver_state, actor, row_eff, sends_eff, set_eff, cancel_eff
         )
 
         timeout_state, ov_t = self._apply_callback(
             dict(state), actor, row_new, sends, set_bits, cancel_bits,
             fired_bit=t_bit,
         )
+
+        if crashes:
+            crash_state = dict(state)
+            crash_state["crashed"] = state["crashed"].at[crash_actor].set(
+                jnp.uint32(1)
+            )
+            crash_state["timers"] = state["timers"].at[crash_actor].set(
+                jnp.uint32(0)
+            )
 
         overflow = (valid_deliver & ov_d) | (valid_timeout & ov_t)
 
@@ -503,7 +732,11 @@ class PackedActorModel(ActorModel, BatchableModel):
 
         out = pick(drop_state, deliver_state, is_drop)
         out = pick(timeout_state, out, is_timeout)
-        valid = (valid_deliver | valid_drop | valid_timeout) & ~overflow
+        if crashes:
+            out = pick(crash_state, out, is_crash)
+        valid = (
+            valid_deliver | valid_drop | valid_timeout | valid_crash
+        ) & ~overflow
         # Guard: an invalid lane must still produce canonical arrays.
         out = self._canonicalize(out)
         return out, valid

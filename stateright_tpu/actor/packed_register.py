@@ -1,0 +1,238 @@
+"""Packed helpers for register-protocol actor systems (paxos / ABD / ...).
+
+The reference's register harness (``/root/reference/src/actor/register.rs``)
+pairs protocol servers with ``RegisterActor`` clients and plugs the message
+flow into a consistency tester via history hooks. This module is the packed
+twin shared by every such codec:
+
+- canonical message kind codes for the client-facing protocol (codecs place
+  their internal protocol kinds at ``KIND_INTERNAL_BASE`` and up);
+- pack/unpack + the traceable ``on_msg`` kernel for ``RegisterClient`` rows;
+- the history routing hooks mapping Put/Get sends to tester invocations and
+  PutOk/GetOk deliveries to returns (host analogs: ``record_invocations`` /
+  ``record_returns`` in ``stateright_tpu/actor/register.py``).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .packed import ActorPackedCodec
+from .register import ClientState
+
+# Client-facing message kinds, shared across register-protocol codecs.
+# 0 is reserved (empty envelope slots hash as zeros).
+K_PUT, K_GET, K_PUT_OK, K_GET_OK = 1, 2, 3, 4
+KIND_INTERNAL_BASE = 5
+
+# Client rows are [has_awaiting, awaiting, op_count]; codecs pad to their
+# server row width.
+CLIENT_ROW_WORDS = 3
+
+
+def pack_client_state(state: ClientState, width: int) -> np.ndarray:
+    row = np.zeros((width,), np.uint32)
+    if state.awaiting is not None:
+        row[0] = 1
+        row[1] = state.awaiting
+    row[2] = state.op_count
+    return row
+
+
+def unpack_client_state(row) -> ClientState:
+    return ClientState(
+        awaiting=int(row[1]) if int(row[0]) else None,
+        op_count=int(row[2]),
+    )
+
+
+def client_on_msg_branch(codec, put_count: int, server_count: int):
+    """The traceable twin of ``RegisterClient.on_msg``: PutOk advances to the
+    next Put or the final Get; GetOk completes the run. Round-robin
+    destination ``(index + op_count) % server_count``, request id
+    ``(op_count + 1) * index``, values ``'Z' - (index - server_count)``."""
+    import jax.numpy as jnp
+
+    u = jnp.uint32
+    W = codec.msg_width
+
+    def no_sends():
+        return jnp.full((codec.send_capacity, 1 + W), codec.SEND_NONE)
+
+    def msg_vec(kind, req, val):
+        vec = jnp.zeros((W,), u)
+        vec = vec.at[0].set(kind).at[1].set(req)
+        return vec.at[2].set(val)
+
+    def on_msg(me, row, src, msg):
+        kind, req = msg[0], msg[1]
+        has_aw, aw, opc = row[0], row[1], row[2]
+        meu = me.astype(u)
+        sc = u(server_count)
+
+        awaited = (has_aw == 1) & (req == aw)
+        put_done = (kind == u(K_PUT_OK)) & awaited
+        get_done = (kind == u(K_GET_OK)) & awaited
+
+        nreq = (opc + 1) * meu
+        dst = (meu + opc) % sc
+        more_puts = opc < u(put_count)
+        zval = u(ord("Z")) - (meu - sc)
+        next_msg = jnp.where(
+            more_puts, msg_vec(u(K_PUT), nreq, zval), msg_vec(u(K_GET), nreq, u(0))
+        )
+        p_sends = no_sends().at[0].set(
+            jnp.concatenate([dst[None], next_msg])
+        )
+        p_row = row.at[0].set(u(1)).at[1].set(nreq).at[2].set(opc + 1)
+        g_row = row.at[0].set(u(0)).at[1].set(u(0)).at[2].set(opc + 1)
+
+        row_out = jnp.where(put_done, p_row, jnp.where(get_done, g_row, row))
+        sends = jnp.where(put_done, p_sends, no_sends())
+        changed = put_done | get_done
+        zero = u(0)
+        return row_out, sends, zero, zero, changed
+
+    return on_msg
+
+
+def make_history_hooks(lin, server_count: int):
+    """(history_on_deliver, history_on_send) for a codec whose client threads
+    are actors ``server_count..N`` and whose messages use the kind codes
+    above. ``lin`` is a ``PackedRegisterLinearizability``."""
+    import jax.numpy as jnp
+
+    u = jnp.uint32
+    C = lin.C
+
+    def on_send(model, hist, src, dst, msg):
+        # record_invocations: a Put/Get entering the network invokes
+        # Write/Read for thread = the sender.
+        kind = msg[0]
+        is_put = kind == u(K_PUT)
+        is_get = kind == u(K_GET)
+        c = jnp.clip(src - server_count, 0, C - 1).astype(jnp.int32)
+        active = (src >= server_count) & (is_put | is_get)
+        op_kind = jnp.where(is_put, u(1), u(2))
+        return lin.on_invoke(hist, c, op_kind, msg[2], active)
+
+    def on_deliver(model, hist, src, dst, msg):
+        # record_returns: a PutOk/GetOk delivered to a client returns
+        # WriteOk/ReadOk(value) for thread = the recipient.
+        kind = msg[0]
+        is_ret = (kind == u(K_PUT_OK)) | (kind == u(K_GET_OK))
+        c = jnp.clip(dst - server_count, 0, C - 1).astype(jnp.int32)
+        active = (dst >= server_count) & is_ret
+        return lin.on_return(hist, c, msg[2], active)
+
+    return on_deliver, on_send
+
+
+def trace_helpers(codec, server_count: int):
+    """(no_sends, send_row, broadcast) builders shared by server kernels:
+    a blank send table, one send row ``[dst, words..., pad]``, and a
+    broadcast giving every server its own row with ``me``'s left blank."""
+    import jax.numpy as jnp
+
+    u = jnp.uint32
+    W = codec.msg_width
+    S = codec.send_capacity
+
+    def no_sends():
+        return jnp.full((S, 1 + W), codec.SEND_NONE)
+
+    def send_row(dst, *words):
+        vec = jnp.zeros((1 + W,), u).at[0].set(dst)
+        for k, w in enumerate(words):
+            vec = vec.at[1 + k].set(w)
+        return vec
+
+    def broadcast(me, *words):
+        rows = no_sends()
+        meu = me.astype(u)
+        for s in range(server_count):
+            row = send_row(u(s), *words)
+            rows = rows.at[s].set(jnp.where(u(s) == meu, rows[s], row))
+        return rows
+
+    return no_sends, send_row, broadcast
+
+
+class RegisterProtocolCodec(ActorPackedCodec):
+    """Shared base for register-protocol codecs (paxos / ABD / single-copy):
+    servers are actor type 0, clients type 1, and the auxiliary history is a
+    packed ``LinearizabilityTester`` with the standard hooks + conditions
+    (``always linearizable``, ``sometimes value chosen``)."""
+
+    put_count = 1
+
+    def _init_register_protocol(self, client_count, server_count, default_value):
+        from ..semantics.packed_linearizability import (
+            PackedRegisterLinearizability,
+        )
+
+        self.client_count = client_count
+        self.server_count = server_count
+        self._lin = PackedRegisterLinearizability(
+            thread_ids=range(server_count, server_count + client_count),
+            ops_per_thread=self.put_count + 1,
+            default_value=default_value,
+        )
+        self.history_width = self._lin.width
+
+    def actor_type_id(self, i, actor) -> int:
+        return 0 if i < self.server_count else 1
+
+    def pack_history(self, history) -> np.ndarray:
+        return self._lin.pack(history)
+
+    def unpack_history(self, vec):
+        return self._lin.unpack(vec)
+
+    def history_on_deliver(self, model, hist, src, dst, msg):
+        return self._hooks()[0](model, hist, src, dst, msg)
+
+    def history_on_send(self, model, hist, src, dst, msg):
+        return self._hooks()[1](model, hist, src, dst, msg)
+
+    def _hooks(self):
+        if not hasattr(self, "_hooks_cache"):
+            self._hooks_cache = make_history_hooks(
+                self._lin, self.server_count
+            )
+        return self._hooks_cache
+
+    def packed_conditions(self, model):
+        lin_ok = self._lin.predicate()
+        return [
+            lambda state: lin_ok(state["hist"]),
+            value_chosen_condition(model),
+        ]
+
+
+def value_chosen_condition(model):
+    """Traceable twin of the examples' ``sometimes "value chosen"``: some
+    deliverable GetOk carries a non-default value. For ordered networks
+    "deliverable" means flow heads only (host ``iter_deliverable``)."""
+    import jax.numpy as jnp
+
+    if model._ordered:
+
+        def cond(state):
+            head = state["flow_msg"][:, 0, :]
+            live = state["flow_len"] > 0
+            return (
+                live & (head[:, 0] == jnp.uint32(K_GET_OK)) & (head[:, 2] != 0)
+            ).any()
+
+    else:
+
+        def cond(state):
+            kind = state["net_msg"][:, 0]
+            val = state["net_msg"][:, 2]
+            live = state["net_cnt"] > 0
+            return (
+                live & (kind == jnp.uint32(K_GET_OK)) & (val != 0)
+            ).any()
+
+    return cond

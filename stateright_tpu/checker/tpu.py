@@ -71,7 +71,8 @@ class TpuBfsChecker(Checker):
         frontier_capacity=1 << 13,
         table_capacity=1 << 16,
         checkpoint_path=None,
-        checkpoint_every_waves=32,
+        checkpoint_every_chunks=32,
+        checkpoint_min_interval_s=0.0,
         resume_from=None,
     ):
         model = options.model
@@ -108,7 +109,11 @@ class TpuBfsChecker(Checker):
         self._depth_cap = options._target_max_depth or _DEPTH_INF
 
         self._checkpoint_path = checkpoint_path
-        self._checkpoint_every = max(1, checkpoint_every_waves)
+        # Counts dequeued frontier chunks (a wide BFS level splits into many
+        # F_max-sized chunks); the time floor keeps wide frontiers from
+        # checkpointing (full parent-map export + pickle) back to back.
+        self._checkpoint_every = max(1, checkpoint_every_chunks)
+        self._checkpoint_min_interval = checkpoint_min_interval_s
         self._resume_from = resume_from
 
         self._state_count = 0
@@ -125,12 +130,15 @@ class TpuBfsChecker(Checker):
         self._done_event = threading.Event()
         self._error: Optional[BaseException] = None
 
+        # Fingerprints go through the model's view hook (e.g. actor systems
+        # exclude crash flags, mirroring the host state hash).
+        self._fp_fn = lambda s: fingerprint_state(model.packed_fingerprint_view(s))
         self._jit_wave = jax.jit(self._wave)
         self._jit_init = jax.jit(self._init_wave)
         self._jit_take = jax.jit(self._take, static_argnums=(2,))
         self._jit_finish = jax.jit(self._finish, static_argnums=(2,))
         self._jit_rehash = jax.jit(self._rehash)
-        self._jit_fp_single = jax.jit(fingerprint_state)
+        self._jit_fp_single = jax.jit(self._fp_fn)
 
         self._handles = [
             threading.Thread(target=self._run, name="tpu-bfs", daemon=True)
@@ -142,7 +150,7 @@ class TpuBfsChecker(Checker):
     def _init_wave(self, table):
         states = self._model.packed_init_states()
         valid = jax.vmap(self._model.packed_within_boundary)(states)
-        hi, lo = jax.vmap(fingerprint_state)(states)
+        hi, lo = jax.vmap(self._fp_fn)(states)
         n0 = hi.shape[0]
         shi = jnp.where(valid, hi, _U32_MAX)
         slo = jnp.where(valid, lo, _U32_MAX)
@@ -195,7 +203,7 @@ class TpuBfsChecker(Checker):
             lambda x: x.reshape((B,) + x.shape[2:]), cand
         )
         cvalid_flat = cvalid.reshape(B)
-        chi, clo = jax.vmap(fingerprint_state)(cand_flat)
+        chi, clo = jax.vmap(self._fp_fn)(cand_flat)
         shi = jnp.where(cvalid_flat, chi, _U32_MAX)
         slo = jnp.where(cvalid_flat, clo, _U32_MAX)
         shi, slo, sidx = jax.lax.sort(
@@ -335,7 +343,8 @@ class TpuBfsChecker(Checker):
             table, queue = self._seed()
         depth_cap = jnp.int32(self._depth_cap)
 
-        waves = 0
+        chunks = 0
+        last_checkpoint = time.perf_counter()
         while queue:
             if not props:
                 break
@@ -348,11 +357,14 @@ class TpuBfsChecker(Checker):
                 break
             if (
                 self._checkpoint_path is not None
-                and waves
-                and waves % self._checkpoint_every == 0
+                and chunks
+                and chunks % self._checkpoint_every == 0
+                and (time.perf_counter() - last_checkpoint)
+                >= self._checkpoint_min_interval
             ):
                 self.save_checkpoint(self._checkpoint_path, queue)
-            waves += 1
+                last_checkpoint = time.perf_counter()
+            chunks += 1
             chunk = queue.popleft()
             F = chunk["hi"].shape[0]
             B = F * self._A
@@ -374,12 +386,16 @@ class TpuBfsChecker(Checker):
                     depth_cap,
                 )
                 table = wave["table"]
+                # Single host transfer per wave: [generated, n_new, overflow,
+                # max_depth, any_prop_hit?]; per-property fingerprints are
+                # pulled only on a hit.
+                stats = np.asarray(wave["stats"])
                 if self.warmup_seconds is None:
                     self.warmup_seconds = time.perf_counter() - t_start
                 if attempt == 0:
-                    self._state_count += int(wave["generated"])
-                    self._max_depth = max(self._max_depth, int(wave["max_depth"]))
-                    if props:
+                    self._state_count += int(stats[0])
+                    self._max_depth = max(self._max_depth, int(stats[3]))
+                    if props and stats[4]:
                         hit = np.asarray(wave["prop_hit"])
                         phi = np.asarray(wave["prop_hi"])
                         plo = np.asarray(wave["prop_lo"])
@@ -390,12 +406,12 @@ class TpuBfsChecker(Checker):
                                 )
                     if self._visitor is not None:
                         self._visit_chunk(chunk)
-                n_new = int(wave["n_new"])
+                n_new = int(stats[1])
                 self._unique_count += n_new
                 if n_new:
                     self._log_wave(wave, n_new)
                     self._enqueue(queue, wave, n_new, B)
-                if not int(wave["overflow"]):
+                if not int(stats[2]):
                     break
                 table = self._grow_table(table, self._capacity * 2)
                 attempt += 1

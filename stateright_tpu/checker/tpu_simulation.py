@@ -98,8 +98,9 @@ class TpuSimulationChecker(Checker):
         self._done_event = threading.Event()
         self._error: Optional[BaseException] = None
 
+        self._fp_fn = lambda s: fingerprint_state(model.packed_fingerprint_view(s))
         self._jit_steps = jax.jit(self._run_steps)
-        self._jit_fp_single = jax.jit(fingerprint_state)
+        self._jit_fp_single = jax.jit(self._fp_fn)
 
         self._handles = [
             threading.Thread(target=self._run, name="tpu-sim", daemon=True)
@@ -128,7 +129,7 @@ class TpuSimulationChecker(Checker):
         in_bounds = model.packed_within_boundary(state)
         boundary_end = ~capped & ~in_bounds
 
-        hi, lo = fingerprint_state(state)
+        hi, lo = self._fp_fn(state)
         slots = jnp.arange(D, dtype=jnp.int32)
         seen = slots < depth
         cycle = (seen & (thi == hi) & (tlo == lo)).any()
@@ -302,12 +303,21 @@ class TpuSimulationChecker(Checker):
         if not props:
             return
         carry = self._fresh_carry()
+        # The device counter is int32 (jnp.int64 needs x64 mode) and would
+        # wrap after ~2.15B counted lane-steps if carried across calls, so
+        # each _jit_steps call counts from zero and the host accumulates.
+        count = 0
         while True:
             carry = self._jit_steps(carry)
-            _lanes, stats, disc = carry
-            count = int(stats["count"])
+            lanes, stats, disc = carry
+            count += int(stats["count"])
             self._state_count = count
             self._max_depth = max(self._max_depth, int(stats["max_depth"]))
+            carry = (
+                lanes,
+                {"count": jnp.int32(0), "max_depth": stats["max_depth"]},
+                disc,
+            )
             found = np.asarray(disc["found"])
             if found.any():
                 hi = np.asarray(disc["hi"]).astype(np.uint64)

@@ -76,6 +76,16 @@ class BatchableModel:
 
         return jnp.bool_(True)
 
+    def packed_fingerprint_view(self, state: PackedState) -> PackedState:
+        """The sub-pytree of ``state`` that participates in fingerprints.
+
+        Defaults to the whole state. Models with hash-excluded components
+        override this — e.g. actor systems exclude crash flags, mirroring
+        the host/reference state hash
+        (``/root/reference/src/actor/model_state.rs:86-97``).
+        """
+        return state
+
     # -- host interop ------------------------------------------------------
 
     def pack_state(self, host_state: Any) -> PackedState:

@@ -16,7 +16,11 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import List, Optional, Tuple
 
+import numpy as np
+
 from ..actor import Actor, ActorModel, Id, Network, Out, model_peers
+from ..actor.packed import PackedActorModel
+from ..actor import packed_register as pr
 from ..actor.register import (
     Get,
     GetOk,
@@ -182,6 +186,306 @@ class AbdActor(Actor):
         return None
 
 
+class AbdPackedCodec(pr.RegisterProtocolCodec):
+    """Packed kernels for ``AbdActor`` + ``RegisterClient`` + history.
+
+    Server row (``R = 9 + 4*Ns``):
+    ``[seq_clock, seq_id, val, phase_kind, ph_req, ph_rqr, ph_has_val,
+    ph_val, acks_mask, then per server s: [present, clock, sid, val]]``
+    where ``ph_has_val``/``ph_val`` hold Phase1's pending write or Phase2's
+    pending read (disambiguated by ``phase_kind``), and the per-server
+    slots hold Phase1's query responses. Client rows use the shared
+    register layout.
+
+    Messages (``W = 5``): register kinds 1-4, then Query=5 ``[k, req]``,
+    AckQuery=6 / Record=7 ``[k, req, clock, sid, val]``, AckRecord=8
+    ``[k, req]``.
+    """
+
+    K_QUERY = pr.KIND_INTERNAL_BASE
+    K_ACK_QUERY = pr.KIND_INTERNAL_BASE + 1
+    K_RECORD = pr.KIND_INTERNAL_BASE + 2
+    K_ACK_RECORD = pr.KIND_INTERNAL_BASE + 3
+
+    msg_width = 5
+
+    def __init__(self, client_count: int, server_count: int):
+        self.state_width = 9 + 4 * server_count
+        self.send_capacity = server_count
+        self._init_register_protocol(client_count, server_count, DEFAULT_VALUE)
+
+    # -- host <-> packed ---------------------------------------------------
+
+    def pack_actor_state(self, i, s) -> np.ndarray:
+        if i >= self.server_count:
+            return pr.pack_client_state(s, self.state_width)
+        row = np.zeros((self.state_width,), np.uint32)
+        row[0], row[1], row[2] = s.seq[0], int(s.seq[1]), ord(s.val)
+        if isinstance(s.phase, Phase1):
+            row[3] = 1
+            row[4], row[5] = s.phase.request_id, int(s.phase.requester_id)
+            if s.phase.write is not None:
+                row[6], row[7] = 1, ord(s.phase.write)
+            for sid, (seq, val) in s.phase.responses:
+                b = 9 + 4 * int(sid)
+                row[b : b + 4] = [1, seq[0], int(seq[1]), ord(val)]
+        elif isinstance(s.phase, Phase2):
+            row[3] = 2
+            row[4], row[5] = s.phase.request_id, int(s.phase.requester_id)
+            if s.phase.read is not None:
+                row[6], row[7] = 1, ord(s.phase.read)
+            for a in s.phase.acks:
+                row[8] |= np.uint32(1) << np.uint32(int(a))
+        return row
+
+    def unpack_actor_state(self, i, row):
+        if i >= self.server_count:
+            return pr.unpack_client_state(row)
+        row = np.asarray(row)
+        phase = None
+        if int(row[3]) == 1:
+            responses = []
+            for s in range(self.server_count):
+                b = 9 + 4 * s
+                if row[b]:
+                    responses.append(
+                        (
+                            Id(s),
+                            ((int(row[b + 1]), Id(int(row[b + 2]))), chr(row[b + 3])),
+                        )
+                    )
+            phase = Phase1(
+                request_id=int(row[4]),
+                requester_id=Id(int(row[5])),
+                write=chr(row[7]) if row[6] else None,
+                responses=tuple(responses),
+            )
+        elif int(row[3]) == 2:
+            phase = Phase2(
+                request_id=int(row[4]),
+                requester_id=Id(int(row[5])),
+                read=chr(row[7]) if row[6] else None,
+                acks=tuple(
+                    Id(b)
+                    for b in range(self.server_count)
+                    if int(row[8]) & (1 << b)
+                ),
+            )
+        return AbdState(
+            seq=(int(row[0]), Id(int(row[1]))), val=chr(row[2]), phase=phase
+        )
+
+    def pack_msg(self, msg) -> np.ndarray:
+        vec = np.zeros((self.msg_width,), np.uint32)
+        if isinstance(msg, Put):
+            vec[:3] = [pr.K_PUT, msg.request_id, ord(msg.value)]
+        elif isinstance(msg, Get):
+            vec[:2] = [pr.K_GET, msg.request_id]
+        elif isinstance(msg, PutOk):
+            vec[:2] = [pr.K_PUT_OK, msg.request_id]
+        elif isinstance(msg, GetOk):
+            vec[:3] = [pr.K_GET_OK, msg.request_id, ord(msg.value)]
+        elif isinstance(msg, Internal):
+            inner = msg.msg
+            kind = inner[0]
+            if kind == "Query":
+                vec[:2] = [self.K_QUERY, inner[1]]
+            elif kind == "AckQuery":
+                vec[:5] = [
+                    self.K_ACK_QUERY,
+                    inner[1],
+                    inner[2][0],
+                    int(inner[2][1]),
+                    ord(inner[3]),
+                ]
+            elif kind == "Record":
+                vec[:5] = [
+                    self.K_RECORD,
+                    inner[1],
+                    inner[2][0],
+                    int(inner[2][1]),
+                    ord(inner[3]),
+                ]
+            elif kind == "AckRecord":
+                vec[:2] = [self.K_ACK_RECORD, inner[1]]
+            else:
+                raise ValueError(f"unknown internal message: {inner!r}")
+        else:
+            raise TypeError(f"cannot pack message: {msg!r}")
+        return vec
+
+    def unpack_msg(self, vec):
+        vec = np.asarray(vec)
+        k = int(vec[0])
+        if k == pr.K_PUT:
+            return Put(int(vec[1]), chr(vec[2]))
+        if k == pr.K_GET:
+            return Get(int(vec[1]))
+        if k == pr.K_PUT_OK:
+            return PutOk(int(vec[1]))
+        if k == pr.K_GET_OK:
+            return GetOk(int(vec[1]), chr(vec[2]))
+        if k == self.K_QUERY:
+            return Internal(("Query", int(vec[1])))
+        seq = (int(vec[2]), Id(int(vec[3])))
+        if k == self.K_ACK_QUERY:
+            return Internal(("AckQuery", int(vec[1]), seq, chr(vec[4])))
+        if k == self.K_RECORD:
+            return Internal(("Record", int(vec[1]), seq, chr(vec[4])))
+        if k == self.K_ACK_RECORD:
+            return Internal(("AckRecord", int(vec[1])))
+        raise ValueError(f"unknown packed message kind: {k}")
+
+    # -- traceable kernels -------------------------------------------------
+
+    def on_msg_branches(self, model):
+        import jax
+        import jax.numpy as jnp
+
+        u = jnp.uint32
+        Ns = self.server_count
+        maj = majority(Ns)
+        no_sends, send_row, broadcast = pr.trace_helpers(self, Ns)
+
+        def seq_gt(c1, s1, c2, s2):
+            return (c1 > c2) | ((c1 == c2) & (s1 > s2))
+
+        def server_on_msg(me, row, src, msg):
+            kind = msg[0]
+            meu = me.astype(u)
+            srcu = src.astype(u)
+            z = u(0)
+            ns = no_sends()
+            sq_c, sq_s, val = row[0], row[1], row[2]
+            phase = row[3]
+            ph_req, ph_rqr = row[4], row[5]
+            ph_has, ph_val = row[6], row[7]
+            acks = row[8]
+            req = msg[1]
+
+            # ---- Put/Get (idle): start phase 1 ----------------------------
+            start_fire = (
+                ((kind == u(pr.K_PUT)) | (kind == u(pr.K_GET)))
+                & (phase == 0)
+            )
+            is_put = kind == u(pr.K_PUT)
+            start_row = (
+                row.at[3].set(u(1)).at[4].set(req).at[5].set(srcu)
+                .at[6].set(jnp.where(is_put, u(1), z))
+                .at[7].set(jnp.where(is_put, msg[2], z))
+            )
+            own_resp = jnp.stack([u(1), sq_c, sq_s, val])
+            for s in range(Ns):
+                b = 9 + 4 * s
+                ent = jnp.where(u(s) == meu, own_resp, jnp.zeros((4,), u))
+                start_row = start_row.at[b : b + 4].set(ent)
+            start_sends = broadcast(meu, u(self.K_QUERY), req)
+
+            # ---- Query: answer with current (seq, val) --------------------
+            query_fire = kind == u(self.K_QUERY)
+            query_sends = ns.at[0].set(
+                send_row(srcu, u(self.K_ACK_QUERY), req, sq_c, sq_s, val)
+            )
+
+            # ---- AckQuery (phase 1, matching request) ---------------------
+            ackq_fire = (
+                (kind == u(self.K_ACK_QUERY)) & (phase == 1) & (ph_req == req)
+            )
+            resp_ent = jnp.stack([u(1), msg[2], msg[3], msg[4]])
+            aq_row = row
+            for s in range(Ns):
+                b = 9 + 4 * s
+                aq_row = aq_row.at[b : b + 4].set(
+                    jnp.where(srcu == u(s), resp_ent, aq_row[b : b + 4])
+                )
+            count = z
+            for s in range(Ns):
+                count = count + aq_row[9 + 4 * s]
+            quorum = count == u(maj)
+            # max response by seq (sequencers are distinct).
+            best = aq_row[9:13]
+            for s in range(1, Ns):
+                ent = aq_row[9 + 4 * s : 13 + 4 * s]
+                better = (ent[0] > best[0]) | (
+                    (ent[0] == best[0]) & seq_gt(ent[1], ent[2], best[1], best[2])
+                )
+                best = jnp.where(better, ent, best)
+            m_c, m_s, m_v = best[1], best[2], best[3]
+            w_c, w_s, w_v = m_c + 1, meu, ph_val  # write: bump clock
+            n_c = jnp.where(ph_has == 1, w_c, m_c)
+            n_s = jnp.where(ph_has == 1, w_s, m_s)
+            n_v = jnp.where(ph_has == 1, w_v, m_v)
+            adopt = seq_gt(n_c, n_s, sq_c, sq_s)
+            q_row = (
+                aq_row.at[0].set(jnp.where(adopt, n_c, sq_c))
+                .at[1].set(jnp.where(adopt, n_s, sq_s))
+                .at[2].set(jnp.where(adopt, n_v, val))
+                .at[3].set(u(2))
+                .at[6].set(jnp.where(ph_has == 1, z, u(1)))
+                .at[7].set(jnp.where(ph_has == 1, z, m_v))
+                .at[8].set(u(1) << meu)
+            )
+            for s in range(Ns):
+                b = 9 + 4 * s
+                q_row = q_row.at[b : b + 4].set(jnp.zeros((4,), u))
+            q_sends = broadcast(meu, u(self.K_RECORD), ph_req, n_c, n_s, n_v)
+            aq_row = jnp.where(quorum, q_row, aq_row)
+            aq_sends = jnp.where(quorum, q_sends, ns)
+
+            # ---- Record: ack; adopt if newer ------------------------------
+            rec_fire = kind == u(self.K_RECORD)
+            rec_adopt = seq_gt(msg[2], msg[3], sq_c, sq_s)
+            rec_row = (
+                row.at[0].set(jnp.where(rec_adopt, msg[2], sq_c))
+                .at[1].set(jnp.where(rec_adopt, msg[3], sq_s))
+                .at[2].set(jnp.where(rec_adopt, msg[4], val))
+            )
+            rec_sends = ns.at[0].set(
+                send_row(srcu, u(self.K_ACK_RECORD), req)
+            )
+
+            # ---- AckRecord (phase 2, matching, new acker) -----------------
+            ackr_fire = (
+                (kind == u(self.K_ACK_RECORD))
+                & (phase == 2)
+                & (ph_req == req)
+                & (((acks >> srcu) & u(1)) == 0)
+            )
+            acks2 = acks | (u(1) << srcu)
+            r_quorum = jax.lax.population_count(acks2) == u(maj)
+            done_row = (
+                row.at[3].set(z).at[4].set(z).at[5].set(z)
+                .at[6].set(z).at[7].set(z).at[8].set(z)
+            )
+            cont_row = row.at[8].set(acks2)
+            ar_row = jnp.where(r_quorum, done_row, cont_row)
+            reply = jnp.where(
+                ph_has == 1,
+                send_row(ph_rqr, u(pr.K_GET_OK), ph_req, ph_val),
+                send_row(ph_rqr, u(pr.K_PUT_OK), ph_req),
+            )
+            ar_sends = jnp.where(r_quorum, ns.at[0].set(reply), ns)
+
+            # ---- select ----------------------------------------------------
+            row_out = row
+            sends = ns
+            changed = jnp.bool_(False)
+            for fire, r, sd, ch in (
+                (start_fire, start_row, start_sends, jnp.bool_(True)),
+                (query_fire, row, query_sends, jnp.bool_(False)),
+                (ackq_fire, aq_row, aq_sends, jnp.bool_(True)),
+                (rec_fire, rec_row, rec_sends, rec_adopt),
+                (ackr_fire, ar_row, ar_sends, jnp.bool_(True)),
+            ):
+                row_out = jnp.where(fire, r, row_out)
+                sends = jnp.where(fire, sd, sends)
+                changed = jnp.where(fire, ch, changed)
+            return row_out, sends, z, z, changed
+
+        client = pr.client_on_msg_branch(self, self.put_count, Ns)
+        return [server_on_msg, client]
+
+
 @dataclass
 class AbdModelCfg:
     client_count: int
@@ -189,12 +493,14 @@ class AbdModelCfg:
     network: Network = field(
         default_factory=Network.new_unordered_nonduplicating
     )
+    envelope_capacity: int = 8
 
     def into_model(self) -> ActorModel:
-        model = ActorModel(
+        model = PackedActorModel(
+            codec=AbdPackedCodec(self.client_count, self.server_count),
             cfg=self,
             init_history=LinearizabilityTester(Register(DEFAULT_VALUE)),
-        )
+        ).with_envelope_capacity(self.envelope_capacity)
         for i in range(self.server_count):
             model.actor(AbdActor(model_peers(i, self.server_count)))
         for _ in range(self.client_count):

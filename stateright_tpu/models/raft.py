@@ -356,11 +356,17 @@ class RaftPackedCodec(ActorPackedCodec):
         import jax.numpy as jnp
 
         n = self.n
+        crashes = bool(model._max_crashes)
+
+        def live(state):
+            if crashes:
+                return state["crashed"] == 0
+            return jnp.ones((n,), bool)
 
         def election_safety(state):
             role = state["rows"][:, 0]
             term = state["rows"][:, 1]
-            lead = role == 2
+            lead = (role == 2) & live(state)
             pair = (
                 lead[:, None]
                 & lead[None, :]
@@ -370,7 +376,7 @@ class RaftPackedCodec(ActorPackedCodec):
             return ~pair.any()
 
         def leader_elected(state):
-            return (state["rows"][:, 0] == 2).any()
+            return ((state["rows"][:, 0] == 2) & live(state)).any()
 
         return [election_safety, leader_elected, leader_elected]
 

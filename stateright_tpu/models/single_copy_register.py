@@ -8,7 +8,11 @@ from __future__ import annotations
 
 from dataclasses import dataclass, field
 
+import numpy as np
+
 from ..actor import Actor, ActorModel, Id, Network, Out
+from ..actor.packed import PackedActorModel
+from ..actor import packed_register as pr
 from ..actor.register import (
     Get,
     GetOk,
@@ -40,6 +44,84 @@ class SingleCopyActor(Actor):
         return None
 
 
+class SingleCopyPackedCodec(pr.RegisterProtocolCodec):
+    """Packed kernels for the single-copy server + register clients.
+    Server row ``[val, 0, 0]``; messages are the shared register kinds
+    (``W = 3``: ``[kind, req, val]``)."""
+
+    msg_width = 3
+    state_width = pr.CLIENT_ROW_WORDS
+
+    def __init__(self, client_count: int, server_count: int):
+        self.send_capacity = 1
+        self._init_register_protocol(client_count, server_count, DEFAULT_VALUE)
+
+    def pack_actor_state(self, i, s) -> np.ndarray:
+        if i >= self.server_count:
+            return pr.pack_client_state(s, self.state_width)
+        row = np.zeros((self.state_width,), np.uint32)
+        row[0] = ord(s)
+        return row
+
+    def unpack_actor_state(self, i, row):
+        if i >= self.server_count:
+            return pr.unpack_client_state(row)
+        return chr(np.asarray(row)[0])
+
+    def pack_msg(self, msg) -> np.ndarray:
+        vec = np.zeros((self.msg_width,), np.uint32)
+        if isinstance(msg, Put):
+            vec[:] = [pr.K_PUT, msg.request_id, ord(msg.value)]
+        elif isinstance(msg, Get):
+            vec[:2] = [pr.K_GET, msg.request_id]
+        elif isinstance(msg, PutOk):
+            vec[:2] = [pr.K_PUT_OK, msg.request_id]
+        elif isinstance(msg, GetOk):
+            vec[:] = [pr.K_GET_OK, msg.request_id, ord(msg.value)]
+        else:
+            raise TypeError(f"cannot pack message: {msg!r}")
+        return vec
+
+    def unpack_msg(self, vec):
+        vec = np.asarray(vec)
+        k = int(vec[0])
+        if k == pr.K_PUT:
+            return Put(int(vec[1]), chr(vec[2]))
+        if k == pr.K_GET:
+            return Get(int(vec[1]))
+        if k == pr.K_PUT_OK:
+            return PutOk(int(vec[1]))
+        if k == pr.K_GET_OK:
+            return GetOk(int(vec[1]), chr(vec[2]))
+        raise ValueError(f"unknown packed message kind: {k}")
+
+    def on_msg_branches(self, model):
+        import jax.numpy as jnp
+
+        u = jnp.uint32
+        W = self.msg_width
+
+        def server_on_msg(me, row, src, msg):
+            kind, req = msg[0], msg[1]
+            srcu = src.astype(u)
+            z = u(0)
+            ns = jnp.full((1, 1 + W), self.SEND_NONE)
+            is_put = kind == u(pr.K_PUT)
+            is_get = kind == u(pr.K_GET)
+            put_send = jnp.stack([srcu, u(pr.K_PUT_OK), req, z])
+            get_send = jnp.stack([srcu, u(pr.K_GET_OK), req, row[0]])
+            sends = jnp.where(
+                is_put,
+                ns.at[0].set(put_send),
+                jnp.where(is_get, ns.at[0].set(get_send), ns),
+            )
+            row_out = row.at[0].set(jnp.where(is_put, msg[2], row[0]))
+            return row_out, sends, z, z, is_put
+
+        client = pr.client_on_msg_branch(self, self.put_count, self.server_count)
+        return [server_on_msg, client]
+
+
 @dataclass
 class SingleCopyModelCfg:
     client_count: int
@@ -47,12 +129,14 @@ class SingleCopyModelCfg:
     network: Network = field(
         default_factory=Network.new_unordered_nonduplicating
     )
+    envelope_capacity: int = 8
 
     def into_model(self) -> ActorModel:
-        model = ActorModel(
+        model = PackedActorModel(
+            codec=SingleCopyPackedCodec(self.client_count, self.server_count),
             cfg=self,
             init_history=LinearizabilityTester(Register(DEFAULT_VALUE)),
-        )
+        ).with_envelope_capacity(self.envelope_capacity)
         for _ in range(self.server_count):
             model.actor(SingleCopyActor())
         for _ in range(self.client_count):

@@ -96,6 +96,8 @@ class NativeFingerprintStore:
             raise RuntimeError("native fp_store unavailable")
         self._lib = lib
         self._ptr = lib.fps_new(ctypes.c_uint64(capacity_hint))
+        if not self._ptr:
+            raise MemoryError("fps_new: allocation failed")
         self._oplock = threading.Lock()
 
     def __del__(self):
@@ -113,11 +115,14 @@ class NativeFingerprintStore:
         parents, pbuf = _as_u64_buf(parents)
         assert children.shape == parents.shape
         with self._oplock:
-            return int(
+            fresh = int(
                 self._lib.fps_insert_batch(
                     self._ptr, cbuf, pbuf, ctypes.c_uint64(children.shape[0])
                 )
             )
+        if fresh == 0xFFFFFFFFFFFFFFFF:
+            raise MemoryError("fp_store: table growth allocation failed")
+        return fresh
 
     def __contains__(self, fp: int) -> bool:
         with self._oplock:

@@ -43,11 +43,19 @@ uint64_t pow2ceil(uint64_t n) {
   return c;
 }
 
-void grow(Store *s, uint64_t min_capacity) {
+// Returns 0 on success, -1 on allocation failure (store left intact — the
+// store holds every visited fingerprint, so exhausting host memory here is
+// plausible and must surface as an error, not a segfault).
+int grow(Store *s, uint64_t min_capacity) {
   uint64_t new_cap = s->capacity;
   while (new_cap < min_capacity || s->size * 10 >= new_cap * 7) new_cap <<= 1;
   uint64_t *nk = (uint64_t *)calloc(new_cap, sizeof(uint64_t));
   uint64_t *np = (uint64_t *)calloc(new_cap, sizeof(uint64_t));
+  if (!nk || !np) {
+    free(nk);
+    free(np);
+    return -1;
+  }
   uint64_t mask = new_cap - 1;
   for (uint64_t i = 0; i < s->capacity; i++) {
     uint64_t k = s->keys[i];
@@ -62,6 +70,7 @@ void grow(Store *s, uint64_t min_capacity) {
   s->keys = nk;
   s->parents = np;
   s->capacity = new_cap;
+  return 0;
 }
 
 // Returns the slot of key, or the empty slot where it would go.
@@ -76,11 +85,19 @@ uint64_t probe(const Store *s, uint64_t key) {
 
 extern "C" {
 
+// Returns NULL on allocation failure.
 void *fps_new(uint64_t capacity_hint) {
   Store *s = (Store *)malloc(sizeof(Store));
+  if (!s) return nullptr;
   s->capacity = pow2ceil(capacity_hint < 64 ? 64 : capacity_hint);
   s->keys = (uint64_t *)calloc(s->capacity, sizeof(uint64_t));
   s->parents = (uint64_t *)calloc(s->capacity, sizeof(uint64_t));
+  if (!s->keys || !s->parents) {
+    free(s->keys);
+    free(s->parents);
+    free(s);
+    return nullptr;
+  }
   s->size = 0;
   return s;
 }
@@ -95,11 +112,14 @@ void fps_free(void *p) {
 uint64_t fps_size(const void *p) { return ((const Store *)p)->size; }
 
 // First-writer-wins batch insert (BFS: the first recorded parent is the
-// shortest-path parent). Returns the number of new keys.
+// shortest-path parent). Returns the number of new keys, or UINT64_MAX if
+// growing the table failed (out of memory; no keys were inserted).
 uint64_t fps_insert_batch(void *p, const uint64_t *children,
                           const uint64_t *parents, uint64_t n) {
   Store *s = (Store *)p;
-  if ((s->size + n) * 10 >= s->capacity * 7) grow(s, pow2ceil(s->size + n) * 2);
+  if ((s->size + n) * 10 >= s->capacity * 7) {
+    if (grow(s, pow2ceil(s->size + n) * 2) != 0) return ~0ULL;
+  }
   uint64_t fresh = 0;
   for (uint64_t i = 0; i < n; i++) {
     uint64_t key = children[i];

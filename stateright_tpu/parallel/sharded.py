@@ -172,8 +172,11 @@ class ShardedTpuBfsChecker(Checker):
                 check_vma=False,
             )
         )
-        self._jit_fp_batch = jax.jit(jax.vmap(fingerprint_state))
-        self._jit_fp_single = jax.jit(fingerprint_state)
+        # Fingerprints go through the model's view hook (e.g. actor systems
+        # exclude crash flags, mirroring the host state hash).
+        self._fp_fn = lambda s: fingerprint_state(model.packed_fingerprint_view(s))
+        self._jit_fp_batch = jax.jit(jax.vmap(self._fp_fn))
+        self._jit_fp_single = jax.jit(self._fp_fn)
 
         self._handles = [
             threading.Thread(target=self._run, name="sharded-tpu-bfs", daemon=True)
@@ -275,7 +278,7 @@ class ShardedTpuBfsChecker(Checker):
             lambda x: x.reshape((B,) + x.shape[2:]), cand
         )
         cvalid_flat = cvalid.reshape(B)
-        chi, clo = jax.vmap(fingerprint_state)(cand_flat)
+        chi, clo = jax.vmap(self._fp_fn)(cand_flat)
 
         # Local pre-dedup: only one lane per distinct key is routed, so the
         # owner-side exchange carries no intra-device duplicates.
@@ -425,7 +428,22 @@ class ShardedTpuBfsChecker(Checker):
             )
             for k in parts[0]
         }
-        chunk["mask"] = np.arange(width) < got
+        # The chunk splits into n contiguous per-device slices; interleave
+        # real rows round-robin so a short chunk (got < width) gives every
+        # shard ~got/n active lanes instead of idling the tail devices.
+        n = self._n
+        per = width // n
+        dest = np.arange(width)
+        src = (dest % per) * n + dest // per
+        chunk = {
+            k: (
+                jax.tree_util.tree_map(lambda x: x[src], v)
+                if k == "states"
+                else v[src]
+            )
+            for k, v in chunk.items()
+        }
+        chunk["mask"] = src < got
         return chunk
 
     def _explore(self):

@@ -20,7 +20,7 @@ def test_resume_completes_the_space(tmp_path):
         .spawn_tpu_bfs(
             frontier_capacity=64,
             checkpoint_path=str(ckpt),
-            checkpoint_every_waves=1,
+            checkpoint_every_chunks=1,
         )
         .join()
     )
@@ -60,7 +60,7 @@ def test_resume_rejects_differently_configured_model(tmp_path):
     TwoPhaseSys(3).checker().target_state_count(50).spawn_tpu_bfs(
         frontier_capacity=64,
         checkpoint_path=str(ckpt),
-        checkpoint_every_waves=1,
+        checkpoint_every_chunks=1,
     ).join()
     assert ckpt.exists()
 
@@ -84,7 +84,7 @@ def test_checkpoint_counts_are_coherent(tmp_path):
         .spawn_tpu_bfs(
             frontier_capacity=32,
             checkpoint_path=str(ckpt),
-            checkpoint_every_waves=1,
+            checkpoint_every_chunks=1,
         )
         .join()
     )

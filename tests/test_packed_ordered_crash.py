@@ -1,0 +1,77 @@
+"""Packed ordered networks + crash faults: device/host exact parity.
+
+Round-2 capability closes (VERDICT items 4): ordered FIFO flows per the
+reference's ``Network::Ordered`` (``src/actor/network.rs:46-68``, head-of-flow
+delivery ``src/actor/model.rs:254-259``) and ``Crash`` actions
+(``src/actor/model.rs:372-381``) on the device path, including the
+hash-excludes-``crashed`` quirk (``src/actor/model_state.rs:86-97``) via
+``packed_fingerprint_view``.
+"""
+
+import numpy as np
+
+from stateright_tpu.actor import Network
+from stateright_tpu.models.linearizable_register import AbdModelCfg
+from stateright_tpu.models.raft import RaftModelCfg
+
+
+def _tpu(model, **kw):
+    kw.setdefault("frontier_capacity", 256)
+    kw.setdefault("table_capacity", 1 << 14)
+    checker = model.checker().spawn_tpu_bfs(**kw).join()
+    assert checker.worker_error() is None
+    return checker
+
+
+def test_ordered_abd_round_trip_and_parity():
+    # The `linearizable-register check N ordered` bench family
+    # (reference bench.sh:31-34), scaled to the 2-client config.
+    model = AbdModelCfg(2, 2, network=Network.new_ordered()).into_model()
+    init = model.init_states()[0]
+    assert model.unpack_state(model.pack_state(init)) == init
+    host = model.checker().spawn_bfs().join()
+    dev = _tpu(model)
+    assert host.unique_state_count() == dev.unique_state_count() == 620
+    assert sorted(host.discoveries()) == sorted(dev.discoveries()) == [
+        "value chosen"
+    ]
+    dev.assert_properties()
+
+
+def test_raft_crash_faults_parity():
+    model = RaftModelCfg(
+        server_count=3, max_term=1, lossy=True, max_crashes=1
+    ).into_model()
+    init = model.init_states()[0]
+    assert model.unpack_state(model.pack_state(init)) == init
+    host = model.checker().spawn_bfs().join()
+    dev = _tpu(model)
+    assert host.unique_state_count() == dev.unique_state_count() == 2252
+    assert sorted(dev.discoveries()) == ["leader elected", "stable leader"]
+
+
+def test_crashed_flags_excluded_from_fingerprint():
+    model = RaftModelCfg(
+        server_count=3, max_term=1, max_crashes=1
+    ).into_model()
+    packed = model.pack_state(model.init_states()[0])
+    view = model.packed_fingerprint_view(packed)
+    assert "crashed" in packed and "crashed" not in view
+
+
+def test_raft_crash_sharded_parity():
+    import jax
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.array(jax.devices()[:8]), ("fp",))
+    checker = (
+        RaftModelCfg(server_count=3, max_term=1, lossy=True, max_crashes=1)
+        .into_model()
+        .checker()
+        .spawn_sharded_tpu_bfs(
+            mesh=mesh, frontier_per_device=64, table_capacity_per_device=1 << 10
+        )
+        .join()
+    )
+    assert checker.worker_error() is None
+    assert checker.unique_state_count() == 2252
