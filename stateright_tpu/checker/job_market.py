@@ -8,8 +8,8 @@ via ``close`` so the remaining workers drain out instead of hanging.
 
 Reference design: ``JobBroker``/``JobMarket`` at
 ``/root/reference/src/job_market.rs``. In the TPU checker this role is played
-by the host<->device frontier scheduler instead
-(``stateright_tpu.parallel.frontier``).
+by the host<->device frontier scheduler instead (the chunk queue/pool in
+``stateright_tpu.checker.tpu`` and ``stateright_tpu.parallel.sharded``).
 """
 
 from __future__ import annotations
