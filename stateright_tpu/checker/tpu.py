@@ -53,6 +53,23 @@ _U32_MAX = np.uint32(0xFFFFFFFF)  # numpy: keeps module import backend-free
 _MAX_LOAD = 0.55
 
 
+def packed_model_digest(model, action_count: int) -> str:
+    """Digest of a model's packed configuration, guarding checkpoint resume:
+    the class-name check alone would let e.g. a 3-RM checkpoint resume a
+    4-RM model."""
+    from hashlib import blake2b
+
+    h = blake2b(digest_size=16)
+    h.update(type(model).__name__.encode())
+    h.update(str(action_count).encode())
+    for leaf in jax.tree_util.tree_leaves(model.packed_init_states()):
+        arr = np.asarray(leaf)
+        h.update(str(arr.shape).encode())
+        h.update(str(arr.dtype).encode())
+        h.update(arr.tobytes())
+    return h.hexdigest()
+
+
 def _pow2ceil(n: int) -> int:
     return 1 << max(0, (n - 1).bit_length())
 
@@ -456,19 +473,7 @@ class TpuBfsChecker(Checker):
     # on a kill, SURVEY §5) ------------------------------------------------
 
     def _model_digest(self) -> str:
-        """Digest of the model's packed configuration: the class-name check
-        alone would let e.g. a 3-RM checkpoint resume a 4-RM model."""
-        from hashlib import blake2b
-
-        h = blake2b(digest_size=16)
-        h.update(type(self._model).__name__.encode())
-        h.update(str(self._A).encode())
-        for leaf in jax.tree_util.tree_leaves(self._model.packed_init_states()):
-            arr = np.asarray(leaf)
-            h.update(str(arr.shape).encode())
-            h.update(str(arr.dtype).encode())
-            h.update(arr.tobytes())
-        return h.hexdigest()
+        return packed_model_digest(self._model, self._A)
 
     def save_checkpoint(self, path, queue) -> None:
         """Atomically serializes counters, discoveries, the parent-pointer
@@ -482,6 +487,7 @@ class TpuBfsChecker(Checker):
         children, parents = self._store.export()
         payload = {
             "version": 1,
+            "kind": "tpu_bfs",
             "model": type(self._model).__name__,
             "model_digest": self._model_digest(),
             "state_count": self._state_count,
@@ -507,6 +513,12 @@ class TpuBfsChecker(Checker):
             payload = pickle.load(f)
         if payload.get("version") != 1:
             raise ValueError(f"unsupported checkpoint version: {payload!r}")
+        if payload.get("kind") != "tpu_bfs":
+            raise ValueError(
+                f"checkpoint kind {payload.get('kind')!r} was not written by "
+                "the single-device TpuBfs checker (sharded checkpoints carry "
+                "a frontier pool, not the chunk queue this restore needs)"
+            )
         if payload["model"] != type(self._model).__name__:
             raise ValueError(
                 f"checkpoint was written by model {payload['model']!r}, "

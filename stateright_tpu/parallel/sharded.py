@@ -35,6 +35,7 @@ ICI within a slice and DCN across slices; nothing here is host-count aware.
 from __future__ import annotations
 
 import threading
+import time
 from collections import deque
 from typing import Dict, List, Optional
 
@@ -52,6 +53,7 @@ from ..ops.fingerprint import fingerprint_state, fp_to_int
 from ..ops.hashset import hashset_insert, hashset_new
 from .base_mesh import default_mesh
 from ..checker.base import Checker
+from ..checker.tpu import packed_model_digest
 
 _DEPTH_INF = (1 << 31) - 1
 _U32_MAX = np.uint32(0xFFFFFFFF)
@@ -95,6 +97,10 @@ class ShardedTpuBfsChecker(Checker):
         mesh: Optional[Mesh] = None,
         frontier_per_device: int = 1 << 10,
         table_capacity_per_device: int = 1 << 15,
+        checkpoint_path=None,
+        checkpoint_every_chunks=32,
+        checkpoint_min_interval_s=0.0,
+        resume_from=None,
     ):
         model = options.model
         if not isinstance(model, BatchableModel):
@@ -131,6 +137,13 @@ class ShardedTpuBfsChecker(Checker):
         self._visitor = options._visitor
         self._target_state_count: Optional[int] = options._target_state_count
         self._depth_cap = options._target_max_depth or _DEPTH_INF
+
+        self._checkpoint_path = checkpoint_path
+        # Counts dequeued global chunks; the time floor keeps wide frontiers
+        # from checkpointing (full parent-map export + pickle) back to back.
+        self._checkpoint_every = max(1, checkpoint_every_chunks)
+        self._checkpoint_min_interval = checkpoint_min_interval_s
+        self._resume_from = resume_from
 
         self._state_count = 0
         self._unique_count = 0
@@ -472,59 +485,16 @@ class ShardedTpuBfsChecker(Checker):
     def _explore(self):
         props = self._properties
         n, G, A = self._n, self._G, self._A
-        model = self._model
-
-        # Seed: fingerprint + dedup-insert the initial states.
-        init = model.packed_init_states()
-        n0 = jax.tree_util.tree_leaves(init)[0].shape[0]
-        width = max(G, n * _pow2ceil((n0 + n - 1) // n))
-
-        def pad0(x):
-            return np.pad(
-                np.asarray(x), [(0, width - n0)] + [(0, 0)] * (x.ndim - 1)
-            )
-
-        init_np = jax.tree_util.tree_map(pad0, init)
-        hi, lo = (np.asarray(a) for a in self._jit_fp_batch(init_np))
-        in_range = np.arange(width) < n0
-        bound = np.asarray(
-            jax.jit(jax.vmap(model.packed_within_boundary))(init_np)
-        )
-        valid = in_range & bound
-
-        table = self._new_table()
-        while True:
-            out = self._jit_insert(
-                table,
-                *(
-                    jax.device_put(jnp.asarray(a), self._shard)
-                    for a in (hi, lo, valid)
-                ),
-            )
-            if not int(np.asarray(out["overflow"]).sum()):
-                break
-            self._cap_loc *= 2
-            table = self._new_table()
-        table = out["table"]
-        fresh = np.asarray(out["fresh"])
-        self._state_count = int(valid.sum())
-        self._unique_count = int(fresh.sum())
-        child64 = (hi.astype(np.uint64) << np.uint64(32)) | lo.astype(np.uint64)
-        self._wave_log.append((child64[fresh], np.zeros((fresh.sum(),), np.uint64)))
-
         self._pool = deque()
         self._pool_count = 0
-        self._pool_append(
-            {
-                "states": jax.tree_util.tree_map(lambda x: x[fresh], init_np),
-                "hi": hi[fresh],
-                "lo": lo[fresh],
-                "ebits": np.full((int(fresh.sum()),), self._ebits0, np.uint32),
-                "depth": np.ones((int(fresh.sum()),), np.int32),
-            }
-        )
+        if self._resume_from is not None:
+            table = self._restore(self._resume_from)
+        else:
+            table = self._seed()
         depth_cap = jnp.int32(self._depth_cap)
 
+        chunks = 0
+        last_checkpoint = time.perf_counter()
         while self._pool_count:
             if not props:
                 break
@@ -535,6 +505,16 @@ class ShardedTpuBfsChecker(Checker):
                 and self._target_state_count <= self._state_count
             ):
                 break
+            if (
+                self._checkpoint_path is not None
+                and chunks
+                and chunks % self._checkpoint_every == 0
+                and (time.perf_counter() - last_checkpoint)
+                >= self._checkpoint_min_interval
+            ):
+                self.save_checkpoint(self._checkpoint_path, self._pool)
+                last_checkpoint = time.perf_counter()
+            chunks += 1
             B_glob = G * A
             if (self._unique_count + B_glob) > _MAX_LOAD * n * self._cap_loc:
                 table = self._grow_table(
@@ -586,6 +566,175 @@ class ShardedTpuBfsChecker(Checker):
                 attempt += 1
             # Re-ingest fresh rows for the next chunks.
             del dev
+
+    def _seed(self):
+        """Fingerprints + dedup-inserts the initial states; returns the
+        sharded visited table and fills the host pool."""
+        n, G = self._n, self._G
+        model = self._model
+        init = model.packed_init_states()
+        n0 = jax.tree_util.tree_leaves(init)[0].shape[0]
+        width = max(G, n * _pow2ceil((n0 + n - 1) // n))
+
+        def pad0(x):
+            return np.pad(
+                np.asarray(x), [(0, width - n0)] + [(0, 0)] * (x.ndim - 1)
+            )
+
+        init_np = jax.tree_util.tree_map(pad0, init)
+        hi, lo = (np.asarray(a) for a in self._jit_fp_batch(init_np))
+        in_range = np.arange(width) < n0
+        bound = np.asarray(
+            jax.jit(jax.vmap(model.packed_within_boundary))(init_np)
+        )
+        valid = in_range & bound
+
+        table = self._new_table()
+        while True:
+            out = self._jit_insert(
+                table,
+                *(
+                    jax.device_put(jnp.asarray(a), self._shard)
+                    for a in (hi, lo, valid)
+                ),
+            )
+            if not int(np.asarray(out["overflow"]).sum()):
+                break
+            self._cap_loc *= 2
+            table = self._new_table()
+        table = out["table"]
+        fresh = np.asarray(out["fresh"])
+        self._state_count = int(valid.sum())
+        self._unique_count = int(fresh.sum())
+        child64 = (hi.astype(np.uint64) << np.uint64(32)) | lo.astype(np.uint64)
+        self._wave_log.append((child64[fresh], np.zeros((fresh.sum(),), np.uint64)))
+
+        self._pool_append(
+            {
+                "states": jax.tree_util.tree_map(lambda x: x[fresh], init_np),
+                "hi": hi[fresh],
+                "lo": lo[fresh],
+                "ebits": np.full((int(fresh.sum()),), self._ebits0, np.uint32),
+                "depth": np.ones((int(fresh.sum()),), np.int32),
+            }
+        )
+        return table
+
+    # -- checkpoint/resume (parity with TpuBfsChecker; SURVEY §5) ----------
+
+    def save_checkpoint(self, path, pool) -> None:
+        """Atomically serializes counters, discoveries, the parent-pointer
+        map, and the host frontier pool. The visited set is not stored —
+        it is exactly the parent map's keys, and the per-shard tables are
+        rebuilt from them on resume (keys re-route by ``hi % n``, so a
+        checkpoint restores onto a mesh of any size).
+
+        Worker-internal (called between chunks, when no chunk is in
+        flight): the explicit ``pool`` argument mirrors ``TpuBfsChecker``'s
+        queue parameter — calling this from another thread mid-run would
+        race the worker's pool mutation and could snapshot an in-flight
+        chunk out of existence."""
+        import os
+        import pickle
+
+        self._ingest_wave_log()
+        children, parents = self._store.export()
+        payload = {
+            "version": 1,
+            "kind": "sharded",
+            "model": type(self._model).__name__,
+            "model_digest": packed_model_digest(self._model, self._A),
+            "state_count": self._state_count,
+            "unique_count": self._unique_count,
+            "max_depth": self._max_depth,
+            "discoveries": dict(self._discoveries_fp),
+            "children": children,
+            "parents": parents,
+            "cap_loc": self._cap_loc,
+            "n_shards": self._n,
+            "pool": [
+                jax.tree_util.tree_map(np.asarray, batch) for batch in pool
+            ],
+        }
+        tmp = f"{path}.tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(payload, f)
+        os.replace(tmp, path)
+
+    def _restore(self, path):
+        import pickle
+
+        with open(path, "rb") as f:
+            payload = pickle.load(f)
+        if payload.get("version") != 1:
+            raise ValueError(f"unsupported checkpoint version: {payload!r}")
+        if payload.get("kind") != "sharded":
+            raise ValueError(
+                f"checkpoint kind {payload.get('kind')!r} was not written by "
+                "the sharded checker (single-device TpuBfs checkpoints do "
+                "not carry the frontier pool this restore needs)"
+            )
+        if payload["model"] != type(self._model).__name__:
+            raise ValueError(
+                f"checkpoint was written by model {payload['model']!r}, "
+                f"resuming with {type(self._model).__name__!r}"
+            )
+        if payload.get("model_digest") != packed_model_digest(
+            self._model, self._A
+        ):
+            raise ValueError(
+                "checkpoint was written by a differently-configured model "
+                "(packed init states / action count do not match); resuming "
+                "would mix two state spaces"
+            )
+        self._state_count = payload["state_count"]
+        self._unique_count = payload["unique_count"]
+        self._max_depth = payload["max_depth"]
+        self._discoveries_fp = dict(payload["discoveries"])
+        children = payload["children"]
+        parents = payload["parents"]
+        self._wave_log.append((children, parents))
+        for batch in payload["pool"]:
+            self._pool_append(batch)
+
+        # Rebuild the sharded visited set by claim-inserting all known keys
+        # through the normal routed insert — each key lands on its owner
+        # shard under the *current* mesh, so shard count may differ from
+        # the writer's.
+        n = self._n
+        if payload["n_shards"] == n:
+            # Same mesh width: start at the writer's shard capacity so the
+            # rebuild needs no growth rounds.
+            self._cap_loc = max(self._cap_loc, payload["cap_loc"])
+        need = _pow2ceil(
+            max(int(len(children) / (_MAX_LOAD * n)), self._cap_loc)
+        )
+        self._cap_loc = need
+        table = self._new_table()
+        hi = (children >> np.uint64(32)).astype(np.uint32)
+        lo = (children & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+        W = n * (1 << 13)
+        for start in range(0, len(children), W):
+            bh = hi[start : start + W]
+            bl = lo[start : start + W]
+            m = len(bh)
+            if m < W:
+                bh = np.pad(bh, (0, W - m))
+                bl = np.pad(bl, (0, W - m))
+            valid = np.arange(W) < m
+            while True:
+                out = self._jit_insert(
+                    table,
+                    *(
+                        jax.device_put(jnp.asarray(a), self._shard)
+                        for a in (bh, bl, valid)
+                    ),
+                )
+                table = out["table"]
+                if not int(np.asarray(out["overflow"]).sum()):
+                    break
+                table = self._grow_table(table, self._cap_loc * 2)
+        return table
 
     def _harvest(self, wave):
         """Pulls each device's compacted fresh rows into the host pool."""

@@ -76,6 +76,106 @@ def test_resume_rejects_differently_configured_model(tmp_path):
     assert "differently-configured" in str(err)
 
 
+def _sharded(model_checker, n_dev=8, **kw):
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.array(jax.devices()[:n_dev]), ("fp",))
+    kw.setdefault("frontier_per_device", 32)
+    kw.setdefault("table_capacity_per_device", 512)
+    return model_checker.spawn_sharded_tpu_bfs(mesh=mesh, **kw)
+
+
+def test_sharded_resume_completes_the_space(tmp_path):
+    ckpt = tmp_path / "2pc-sharded.ckpt"
+    first = _sharded(
+        TwoPhaseSys(4).checker().target_state_count(500),
+        checkpoint_path=str(ckpt),
+        checkpoint_every_chunks=1,
+    ).join()
+    assert first.worker_error() is None
+    assert ckpt.exists()
+    assert first.unique_state_count() < 1568
+
+    resumed = _sharded(
+        TwoPhaseSys(4).checker(), resume_from=str(ckpt)
+    ).join()
+    assert resumed.worker_error() is None
+    assert resumed.unique_state_count() == 1568
+    resumed.assert_properties()
+    # Discovery paths replay through the restored parent map.
+    for path in resumed.discoveries().values():
+        assert len(path) >= 1
+
+
+def test_sharded_resume_on_a_different_mesh_size(tmp_path):
+    # Keys re-route by `hi % n` on restore, so a checkpoint written on an
+    # 8-device mesh resumes on a 4-device one (elastic restart — the
+    # reference has no notion of this at all).
+    ckpt = tmp_path / "2pc-elastic.ckpt"
+    _sharded(
+        TwoPhaseSys(4).checker().target_state_count(500),
+        n_dev=8,
+        checkpoint_path=str(ckpt),
+        checkpoint_every_chunks=1,
+    ).join()
+    assert ckpt.exists()
+    resumed = _sharded(
+        TwoPhaseSys(4).checker(), n_dev=4, resume_from=str(ckpt)
+    ).join()
+    assert resumed.worker_error() is None
+    assert resumed.unique_state_count() == 1568
+    resumed.assert_properties()
+
+
+def test_sharded_resume_rejects_differently_configured_model(tmp_path):
+    ckpt = tmp_path / "2pc-sharded3.ckpt"
+    _sharded(
+        TwoPhaseSys(3).checker().target_state_count(50),
+        checkpoint_path=str(ckpt),
+        checkpoint_every_chunks=1,
+    ).join()
+    assert ckpt.exists()
+
+    resumed = _sharded(TwoPhaseSys(4).checker(), resume_from=str(ckpt))
+    with pytest.raises(RuntimeError):
+        resumed.join()
+    err = resumed.worker_error()
+    assert isinstance(err, ValueError)
+    assert "differently-configured" in str(err)
+
+
+def test_cross_checker_resume_is_rejected(tmp_path):
+    # A TpuBfs checkpoint has a chunk queue, a sharded one a frontier pool;
+    # resuming across kinds must fail loudly, not KeyError mid-restore.
+    ckpt = tmp_path / "kind.ckpt"
+    TwoPhaseSys(3).checker().target_state_count(50).spawn_tpu_bfs(
+        frontier_capacity=64,
+        checkpoint_path=str(ckpt),
+        checkpoint_every_chunks=1,
+    ).join()
+    assert ckpt.exists()
+    resumed = _sharded(TwoPhaseSys(3).checker(), resume_from=str(ckpt))
+    with pytest.raises(RuntimeError):
+        resumed.join()
+    assert "kind" in str(resumed.worker_error())
+
+    ckpt2 = tmp_path / "kind2.ckpt"
+    _sharded(
+        TwoPhaseSys(3).checker().target_state_count(50),
+        checkpoint_path=str(ckpt2),
+        checkpoint_every_chunks=1,
+    ).join()
+    assert ckpt2.exists()
+    resumed2 = TwoPhaseSys(3).checker().spawn_tpu_bfs(
+        frontier_capacity=64, resume_from=str(ckpt2)
+    )
+    with pytest.raises(RuntimeError):
+        resumed2.join()
+    assert "kind" in str(resumed2.worker_error())
+
+
 def test_checkpoint_counts_are_coherent(tmp_path):
     ckpt = tmp_path / "2pc3.ckpt"
     checker = (
