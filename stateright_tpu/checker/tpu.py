@@ -70,6 +70,55 @@ def packed_model_digest(model, action_count: int) -> str:
     return h.hexdigest()
 
 
+def checkpoint_header(kind: str, model, action_count: int) -> dict:
+    """Common checkpoint header shared by every device checker."""
+    return {
+        "version": 1,
+        "kind": kind,
+        "model": type(model).__name__,
+        "model_digest": packed_model_digest(model, action_count),
+    }
+
+
+def validate_checkpoint_header(
+    payload: dict, kind: str, wrong_kind_hint: str, model, action_count: int
+) -> None:
+    """Rejects checkpoints another checker kind, model, or model
+    configuration wrote. Checkpoints predating the ``kind`` field were
+    written by the single-device checker (the only kind that existed)."""
+    if payload.get("version") != 1:
+        raise ValueError(f"unsupported checkpoint version: {payload!r}")
+    found_kind = payload.get("kind", "tpu_bfs")
+    if found_kind != kind:
+        raise ValueError(
+            f"checkpoint kind {found_kind!r} does not match this checker "
+            f"({kind!r}): {wrong_kind_hint}"
+        )
+    if payload["model"] != type(model).__name__:
+        raise ValueError(
+            f"checkpoint was written by model {payload['model']!r}, "
+            f"resuming with {type(model).__name__!r}"
+        )
+    if payload.get("model_digest") != packed_model_digest(model, action_count):
+        raise ValueError(
+            "checkpoint was written by a differently-configured model "
+            "(packed init states / action count do not match); resuming "
+            "would mix two state spaces"
+        )
+
+
+def atomic_pickle(path, payload) -> None:
+    """Writes the pickle to ``path`` atomically (tmp file + rename), so a
+    kill mid-checkpoint never corrupts the previous checkpoint."""
+    import os
+    import pickle
+
+    tmp = f"{path}.tmp"
+    with open(tmp, "wb") as f:
+        pickle.dump(payload, f)
+    os.replace(tmp, path)
+
+
 def _pow2ceil(n: int) -> int:
     return 1 << max(0, (n - 1).bit_length())
 
@@ -480,16 +529,10 @@ class TpuBfsChecker(Checker):
         map, and the pending frontier chunks. The visited set is not stored
         separately — it is exactly the parent map's keys, and the device
         table is rebuilt from them on resume."""
-        import os
-        import pickle
-
         self._ingest_wave_log()
         children, parents = self._store.export()
         payload = {
-            "version": 1,
-            "kind": "tpu_bfs",
-            "model": type(self._model).__name__,
-            "model_digest": self._model_digest(),
+            **checkpoint_header("tpu_bfs", self._model, self._A),
             "state_count": self._state_count,
             "unique_count": self._unique_count,
             "max_depth": self._max_depth,
@@ -501,35 +544,21 @@ class TpuBfsChecker(Checker):
                 jax.tree_util.tree_map(np.asarray, chunk) for chunk in queue
             ],
         }
-        tmp = f"{path}.tmp"
-        with open(tmp, "wb") as f:
-            pickle.dump(payload, f)
-        os.replace(tmp, path)
+        atomic_pickle(path, payload)
 
     def _restore(self, path):
         import pickle
 
         with open(path, "rb") as f:
             payload = pickle.load(f)
-        if payload.get("version") != 1:
-            raise ValueError(f"unsupported checkpoint version: {payload!r}")
-        if payload.get("kind") != "tpu_bfs":
-            raise ValueError(
-                f"checkpoint kind {payload.get('kind')!r} was not written by "
-                "the single-device TpuBfs checker (sharded checkpoints carry "
-                "a frontier pool, not the chunk queue this restore needs)"
-            )
-        if payload["model"] != type(self._model).__name__:
-            raise ValueError(
-                f"checkpoint was written by model {payload['model']!r}, "
-                f"resuming with {type(self._model).__name__!r}"
-            )
-        if payload.get("model_digest") != self._model_digest():
-            raise ValueError(
-                "checkpoint was written by a differently-configured model "
-                "(packed init states / action count do not match); resuming "
-                "would mix two state spaces"
-            )
+        validate_checkpoint_header(
+            payload,
+            "tpu_bfs",
+            "sharded checkpoints carry a frontier pool, not the chunk "
+            "queue this restore needs",
+            self._model,
+            self._A,
+        )
         self._state_count = payload["state_count"]
         self._unique_count = payload["unique_count"]
         self._max_depth = payload["max_depth"]

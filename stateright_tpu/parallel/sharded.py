@@ -53,7 +53,11 @@ from ..ops.fingerprint import fingerprint_state, fp_to_int
 from ..ops.hashset import hashset_insert, hashset_new
 from .base_mesh import default_mesh
 from ..checker.base import Checker
-from ..checker.tpu import packed_model_digest
+from ..checker.tpu import (
+    atomic_pickle,
+    checkpoint_header,
+    validate_checkpoint_header,
+)
 
 _DEPTH_INF = (1 << 31) - 1
 _U32_MAX = np.uint32(0xFFFFFFFF)
@@ -634,16 +638,10 @@ class ShardedTpuBfsChecker(Checker):
         queue parameter — calling this from another thread mid-run would
         race the worker's pool mutation and could snapshot an in-flight
         chunk out of existence."""
-        import os
-        import pickle
-
         self._ingest_wave_log()
         children, parents = self._store.export()
         payload = {
-            "version": 1,
-            "kind": "sharded",
-            "model": type(self._model).__name__,
-            "model_digest": packed_model_digest(self._model, self._A),
+            **checkpoint_header("sharded", self._model, self._A),
             "state_count": self._state_count,
             "unique_count": self._unique_count,
             "max_depth": self._max_depth,
@@ -656,37 +654,21 @@ class ShardedTpuBfsChecker(Checker):
                 jax.tree_util.tree_map(np.asarray, batch) for batch in pool
             ],
         }
-        tmp = f"{path}.tmp"
-        with open(tmp, "wb") as f:
-            pickle.dump(payload, f)
-        os.replace(tmp, path)
+        atomic_pickle(path, payload)
 
     def _restore(self, path):
         import pickle
 
         with open(path, "rb") as f:
             payload = pickle.load(f)
-        if payload.get("version") != 1:
-            raise ValueError(f"unsupported checkpoint version: {payload!r}")
-        if payload.get("kind") != "sharded":
-            raise ValueError(
-                f"checkpoint kind {payload.get('kind')!r} was not written by "
-                "the sharded checker (single-device TpuBfs checkpoints do "
-                "not carry the frontier pool this restore needs)"
-            )
-        if payload["model"] != type(self._model).__name__:
-            raise ValueError(
-                f"checkpoint was written by model {payload['model']!r}, "
-                f"resuming with {type(self._model).__name__!r}"
-            )
-        if payload.get("model_digest") != packed_model_digest(
-            self._model, self._A
-        ):
-            raise ValueError(
-                "checkpoint was written by a differently-configured model "
-                "(packed init states / action count do not match); resuming "
-                "would mix two state spaces"
-            )
+        validate_checkpoint_header(
+            payload,
+            "sharded",
+            "single-device TpuBfs checkpoints do not carry the frontier "
+            "pool this restore needs",
+            self._model,
+            self._A,
+        )
         self._state_count = payload["state_count"]
         self._unique_count = payload["unique_count"]
         self._max_depth = payload["max_depth"]

@@ -78,6 +78,33 @@ def test_sharded_target_max_depth():
     assert capped.unique_state_count() < full.unique_state_count()
 
 
+def test_sharded_eventually_counterexample_replays():
+    # The Raft liveness oracle (tests/test_raft.py) on the sharded mesh:
+    # "stable leader" is an eventually property whose counterexample is a
+    # terminal leaderless schedule; the discovery fingerprint is picked on
+    # one device and must replay through the host model from a sharded run.
+    from stateright_tpu.models.raft import LEADER, RaftModelCfg
+
+    checker = (
+        RaftModelCfg(server_count=3, max_term=1, lossy=True)
+        .into_model()
+        .checker()
+        .spawn_sharded_tpu_bfs(
+            frontier_per_device=64, table_capacity_per_device=1 << 10
+        )
+        .join()
+    )
+    assert checker.worker_error() is None
+    assert checker.unique_state_count() == 665
+    paths = checker.discoveries()
+    # Safety holds; reachability and the liveness counterexample are found.
+    assert set(paths) == {"leader elected", "stable leader"}
+    elected = paths["leader elected"].last_state()
+    assert any(s.role == LEADER for s in elected.actor_states)
+    stuck = paths["stable leader"].last_state()
+    assert not any(s.role == LEADER for s in stuck.actor_states)
+
+
 @pytest.mark.parametrize("n_dev", [1, 2, 4])
 def test_sharded_submesh_sizes(n_dev):
     checker = (
