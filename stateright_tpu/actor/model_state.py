@@ -66,14 +66,11 @@ class ActorModelState:
 
         return stable_hash(self.__stable_fields__())
 
-    def representative(self) -> "ActorModelState":
-        """Canonical member of this state's symmetry equivalence class: sort
-        actor states and rewrite every embedded Id per the sort permutation.
+    def _permuted(self, plan) -> "ActorModelState":
+        """The symmetry group action: permute actor-indexed vectors and
+        rewrite every embedded Id per ``plan``."""
+        from ..utils.rewrite import rewrite_value
 
-        Reference: ``/root/reference/src/actor/model_state.rs:115-132``."""
-        from ..utils.rewrite import RewritePlan, rewrite_value
-
-        plan = RewritePlan.from_values_to_sort(self.actor_states)
         return ActorModelState(
             actor_states=plan.reindex(self.actor_states),
             network=rewrite_network(self.network, plan),
@@ -81,6 +78,25 @@ class ActorModelState:
             crashed=plan.reindex(self.crashed),
             history=rewrite_value(self.history, plan),
         )
+
+    def representative(self) -> "ActorModelState":
+        """Sort-heuristic member of this state's symmetry equivalence class
+        (reference parity: ``/root/reference/src/actor/model_state.rs:115-132``).
+
+        NOT a canonical form — id rewriting changes the sorted rows, so
+        symmetry-reduced counts under this heuristic depend on traversal
+        order. ``orbit_representative`` is the proper alternative."""
+        from ..utils.rewrite import RewritePlan
+
+        return self._permuted(RewritePlan.from_values_to_sort(self.actor_states))
+
+    def orbit_representative(self) -> "ActorModelState":
+        """True orbit canonical form (see ``utils.rewrite.orbit_min``): the
+        same semantics as the device checkers' minimum-fingerprint symmetry
+        key, so host and device symmetry-reduced counts agree exactly."""
+        from ..utils.rewrite import orbit_min
+
+        return orbit_min(len(self.actor_states), self._permuted)
 
     def __repr__(self) -> str:
         return (

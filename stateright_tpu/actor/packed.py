@@ -131,6 +131,22 @@ class ActorPackedCodec:
         """Timer-free codecs (empty ``timer_values``) may return []."""
         return []
 
+    # -- traceable symmetry hooks (device symmetry reduction only) ----------
+
+    def rewrite_actor_row(self, model, row, old_to_new):
+        """Rewrites embedded actor ids inside one packed state row under a
+        permutation (``old_to_new[i]`` = the new id of actor ``i``) — the
+        traceable twin of the host ``rewrite_value`` recursion over the
+        actor state. The default is the identity: correct ONLY for rows
+        with no embedded ids. Codecs whose rows carry ids (votes, leader
+        hints, …) must override, or device symmetry counts will diverge
+        from the host orbit counts (the parity tests' contract)."""
+        return row
+
+    def rewrite_msg_ids(self, model, vec, old_to_new):
+        """Same, for embedded ids inside one packed message vector."""
+        return vec
+
     # -- traceable model hooks ---------------------------------------------
 
     def packed_conditions(self, model) -> List[Callable]:
@@ -416,6 +432,67 @@ class PackedActorModel(ActorModel, BatchableModel):
             net_src=src, net_dst=dst, net_msg=msg, net_cnt=cnt
         )
         return state
+
+    # -- symmetry (orbit-proper; see core/batch.py) ------------------------
+
+    def packed_symmetry(self):
+        from ..core.batch import permutation_tables
+
+        if self.codec.history_width:
+            raise NotImplementedError(
+                "symmetry with packed auxiliary history is unsupported "
+                "(histories carry client identities that are not "
+                "interchangeable)"
+            )
+        return permutation_tables(self._N)
+
+    def packed_apply_permutation(self, state, new_to_old, old_to_new):
+        """The symmetry group action on a packed system state: gather
+        actor-indexed arrays by ``new_to_old``, rewrite embedded ids via the
+        codec hooks, and re-canonicalize the envelope table (device analog
+        of the host ``ActorModelState._permuted``)."""
+        import jax
+        import jax.numpy as jnp
+
+        codec = self.codec
+        n = self._N
+        rows = state["rows"][new_to_old]
+        rows = jax.vmap(
+            lambda r: codec.rewrite_actor_row(self, r, old_to_new)
+        )(rows)
+        out = dict(state, rows=rows, timers=state["timers"][new_to_old])
+        if "crashed" in state:
+            out["crashed"] = state["crashed"][new_to_old]
+        if self._ordered:
+            # Flow (a, b) of the permuted state held flow
+            # (new_to_old[a], new_to_old[b]) originally; queue order is
+            # preserved, so the gathered table stays positionally canonical.
+            flow = (new_to_old[:, None] * n + new_to_old[None, :]).reshape(-1)
+            fmsg = state["flow_msg"][flow]
+            fmsg = jax.vmap(
+                jax.vmap(lambda v: codec.rewrite_msg_ids(self, v, old_to_new))
+            )(fmsg)
+            flen = state["flow_len"][flow]
+            # Re-zero queue padding so id rewrites of dead slots cannot
+            # perturb the canonical array.
+            slot = jnp.arange(fmsg.shape[1])
+            fmsg = jnp.where(
+                slot[None, :, None] < flen[:, None, None], fmsg, jnp.uint32(0)
+            )
+            out.update(flow_msg=fmsg, flow_len=flen)
+        else:
+            cnt = state["net_cnt"]
+            occ = cnt > 0
+            o2n = old_to_new.astype(jnp.uint32)
+            src = jnp.where(occ, o2n[state["net_src"]], jnp.uint32(0))
+            dst = jnp.where(occ, o2n[state["net_dst"]], jnp.uint32(0))
+            msg = jax.vmap(
+                lambda v: codec.rewrite_msg_ids(self, v, old_to_new)
+            )(state["net_msg"])
+            msg = jnp.where(occ[:, None], msg, jnp.uint32(0))
+            out.update(net_src=src, net_dst=dst, net_msg=msg)
+            out = self._canonicalize(out)
+        return out
 
     def _net_send(self, state, src, dst, msg, active):
         """One network send (host ``Network.send``): duplicating nets dedup,

@@ -12,6 +12,14 @@ from typing import Callable, Optional
 from ..core.visitor import CheckerVisitor, FnVisitor
 
 
+def default_representative(state):
+    """The ``symmetry()`` default. A named sentinel so device checkers can
+    tell it apart from a user-supplied ``symmetry_fn`` (whose custom
+    equivalence they cannot honor — they reduce by the full permutation
+    group instead, which would over-merge under a partial symmetry)."""
+    return state.representative()
+
+
 class CheckerBuilder:
     def __init__(self, model):
         self.model = model
@@ -24,8 +32,10 @@ class CheckerBuilder:
     # -- configuration -----------------------------------------------------
 
     def symmetry(self) -> "CheckerBuilder":
-        """Enables symmetry reduction via ``state.representative()``."""
-        return self.symmetry_fn(lambda state: state.representative())
+        """Enables symmetry reduction: host checkers dedup on
+        ``state.representative()``; device checkers use orbit-proper
+        minimum-fingerprint keys (see ``core/batch.py``)."""
+        return self.symmetry_fn(default_representative)
 
     def symmetry_fn(self, representative: Callable) -> "CheckerBuilder":
         self._symmetry = representative

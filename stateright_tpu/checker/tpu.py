@@ -70,21 +70,29 @@ def packed_model_digest(model, action_count: int) -> str:
     return h.hexdigest()
 
 
-def checkpoint_header(kind: str, model, action_count: int) -> dict:
+def checkpoint_header(
+    kind: str, model, action_count: int, symmetry: bool
+) -> dict:
     """Common checkpoint header shared by every device checker."""
     return {
         "version": 1,
         "kind": kind,
         "model": type(model).__name__,
         "model_digest": packed_model_digest(model, action_count),
+        "symmetry": symmetry,
     }
 
 
 def validate_checkpoint_header(
-    payload: dict, kind: str, wrong_kind_hint: str, model, action_count: int
+    payload: dict,
+    kind: str,
+    wrong_kind_hint: str,
+    model,
+    action_count: int,
+    symmetry: bool,
 ) -> None:
-    """Rejects checkpoints another checker kind, model, or model
-    configuration wrote. Checkpoints predating the ``kind`` field were
+    """Rejects checkpoints another checker kind, model, model configuration,
+    or symmetry setting wrote. Checkpoints predating the ``kind`` field were
     written by the single-device checker (the only kind that existed)."""
     if payload.get("version") != 1:
         raise ValueError(f"unsupported checkpoint version: {payload!r}")
@@ -105,6 +113,12 @@ def validate_checkpoint_header(
             "(packed init states / action count do not match); resuming "
             "would mix two state spaces"
         )
+    if payload.get("symmetry", False) != symmetry:
+        raise ValueError(
+            "checkpoint symmetry setting does not match this checker "
+            "(visited keys are orbit-minimum fingerprints under symmetry, "
+            "plain fingerprints otherwise; the two key spaces cannot mix)"
+        )
 
 
 def atomic_pickle(path, payload) -> None:
@@ -117,6 +131,43 @@ def atomic_pickle(path, payload) -> None:
     with open(tmp, "wb") as f:
         pickle.dump(payload, f)
     os.replace(tmp, path)
+
+
+def _make_key_fn(model, fp_fn, symmetry):
+    """Dedup-key function for the device checkers: ``fp_fn`` itself, or the
+    orbit-minimum fingerprint when symmetry reduction is requested."""
+    if symmetry is None:
+        return fp_fn
+    from .builder import default_representative
+
+    if symmetry is not default_representative:
+        raise ValueError(
+            "device checkers cannot honor a custom symmetry_fn: they reduce "
+            "by the full actor-permutation group (orbit-minimum fingerprint "
+            "keys), which would over-merge states under a partial symmetry. "
+            "Use .symmetry(), or a host checker for custom equivalences."
+        )
+    try:
+        n2o, o2n = model.packed_symmetry()
+    except (AttributeError, NotImplementedError) as e:
+        raise TypeError(
+            "symmetry on the device path requires the model to implement "
+            "packed_symmetry()/packed_apply_permutation() (see "
+            "stateright_tpu.core.batch)"
+        ) from e
+    n2o = jnp.asarray(n2o)
+    o2n = jnp.asarray(o2n)
+
+    def orbit_key(s):
+        his, los = jax.vmap(
+            lambda a, b: fp_fn(model.packed_apply_permutation(s, a, b))
+        )(n2o, o2n)
+        # Lexicographic (hi, lo) minimum without sorting the n! pairs.
+        mhi = his.min()
+        mlo = jnp.where(his == mhi, los, _U32_MAX).min()
+        return mhi, mlo
+
+    return orbit_key
 
 
 def _pow2ceil(n: int) -> int:
@@ -190,6 +241,10 @@ class TpuBfsChecker(Checker):
         # ingested into the native parent-pointer store (C++ open-addressing
         # map; see stateright_tpu.native) for path reconstruction.
         self._wave_log: List = []
+        # Under symmetry: the u64 visited-set keys claimed so far (the
+        # checkpoint needs them — the table cannot be rebuilt from the
+        # original fps in the parent store).
+        self._key_log: List = []
         self._store = make_fingerprint_store()
         self._ingested = 0
         self._ingest_lock = threading.Lock()
@@ -199,6 +254,12 @@ class TpuBfsChecker(Checker):
         # Fingerprints go through the model's view hook (e.g. actor systems
         # exclude crash flags, mirroring the host state hash).
         self._fp_fn = lambda s: fingerprint_state(model.packed_fingerprint_view(s))
+        # Dedup keys: plain fingerprints, or — under symmetry reduction —
+        # the minimum fingerprint over every actor permutation (an
+        # orbit-proper canonical key; see core/batch.py for why the
+        # reference's sort heuristic cannot be used on a wave BFS).
+        self._symmetry_enabled = options._symmetry is not None
+        self._key_fn = _make_key_fn(model, self._fp_fn, options._symmetry)
         self._jit_wave = jax.jit(self._wave)
         self._jit_init = jax.jit(self._init_wave)
         self._jit_take = jax.jit(self._take, static_argnums=(2,))
@@ -217,9 +278,13 @@ class TpuBfsChecker(Checker):
         states = self._model.packed_init_states()
         valid = jax.vmap(self._model.packed_within_boundary)(states)
         hi, lo = jax.vmap(self._fp_fn)(states)
+        if self._symmetry_enabled:
+            khi, klo = jax.vmap(self._key_fn)(states)
+        else:
+            khi, klo = hi, lo
         n0 = hi.shape[0]
-        shi = jnp.where(valid, hi, _U32_MAX)
-        slo = jnp.where(valid, lo, _U32_MAX)
+        shi = jnp.where(valid, khi, _U32_MAX)
+        slo = jnp.where(valid, klo, _U32_MAX)
         shi, slo, sidx = jax.lax.sort(
             (shi, slo, jnp.arange(n0, dtype=jnp.int32)), num_keys=2
         )
@@ -234,6 +299,8 @@ class TpuBfsChecker(Checker):
             "valid": valid,
             "hi": hi,
             "lo": lo,
+            "khi": khi,
+            "klo": klo,
             "n_unique": fresh.sum(),
             "n_valid": valid.sum(),
             "overflow": pending.sum(),
@@ -270,8 +337,17 @@ class TpuBfsChecker(Checker):
         )
         cvalid_flat = cvalid.reshape(B)
         chi, clo = jax.vmap(self._fp_fn)(cand_flat)
-        shi = jnp.where(cvalid_flat, chi, _U32_MAX)
-        slo = jnp.where(cvalid_flat, clo, _U32_MAX)
+        # Dedup/visited-set keys (== the fingerprints unless symmetry is on,
+        # when they are orbit-minimum fingerprints). Frontier rows, parent
+        # pointers, and discoveries always carry the ORIGINAL fingerprints
+        # so paths replay through concrete states (the reference keeps
+        # original fps under symmetry too, src/checker/dfs.rs:300-309).
+        if self._symmetry_enabled:
+            khi, klo = jax.vmap(self._key_fn)(cand_flat)
+        else:
+            khi, klo = chi, clo
+        shi = jnp.where(cvalid_flat, khi, _U32_MAX)
+        slo = jnp.where(cvalid_flat, klo, _U32_MAX)
         shi, slo, sidx = jax.lax.sort(
             (shi, slo, jnp.arange(B, dtype=jnp.int32)), num_keys=2
         )
@@ -301,14 +377,20 @@ class TpuBfsChecker(Checker):
             "max_depth": jnp.max(jnp.where(mask, depth, 0)),
             "new": {
                 "states": new_states,
-                "hi": zu.at[out_slot].set(shi, mode="drop"),
-                "lo": zu.at[out_slot].set(slo, mode="drop"),
+                "hi": zu.at[out_slot].set(chi[sidx], mode="drop"),
+                "lo": zu.at[out_slot].set(clo[sidx], mode="drop"),
                 "ebits": zu.at[out_slot].set(ebits_after[parent_row], mode="drop"),
                 "depth": zi.at[out_slot].set(depth[parent_row] + 1, mode="drop"),
             },
             "parent_hi": zu.at[out_slot].set(hi[parent_row], mode="drop"),
             "parent_lo": zu.at[out_slot].set(lo[parent_row], mode="drop"),
         }
+        if self._symmetry_enabled:
+            # The visited-set keys the fresh lanes claimed (orbit-minimum
+            # fps) — checkpointing needs them to rebuild the table, since
+            # original fps cannot be re-keyed without states.
+            out["key_hi"] = zu.at[out_slot].set(shi, mode="drop")
+            out["key_lo"] = zu.at[out_slot].set(slo, mode="drop")
 
         # Per-property discovery scan over the evaluated frontier.
         hits, fhis, flos = [], [], []
@@ -501,6 +583,10 @@ class TpuBfsChecker(Checker):
             valid
         ]
         self._wave_log.append((child64, np.zeros_like(child64)))
+        if self._symmetry_enabled:
+            k_hi = np.asarray(out["khi"]).astype(np.uint64)
+            k_lo = np.asarray(out["klo"]).astype(np.uint64)
+            self._key_log.append(((k_hi << np.uint64(32)) | k_lo)[valid])
 
         F0 = hi.shape[0]
         init_arrs = {
@@ -532,7 +618,9 @@ class TpuBfsChecker(Checker):
         self._ingest_wave_log()
         children, parents = self._store.export()
         payload = {
-            **checkpoint_header("tpu_bfs", self._model, self._A),
+            **checkpoint_header(
+                "tpu_bfs", self._model, self._A, self._symmetry_enabled
+            ),
             "state_count": self._state_count,
             "unique_count": self._unique_count,
             "max_depth": self._max_depth,
@@ -544,6 +632,12 @@ class TpuBfsChecker(Checker):
                 jax.tree_util.tree_map(np.asarray, chunk) for chunk in queue
             ],
         }
+        if self._symmetry_enabled:
+            payload["keys"] = (
+                np.concatenate(self._key_log)
+                if self._key_log
+                else np.zeros((0,), np.uint64)
+            )
         atomic_pickle(path, payload)
 
     def _restore(self, path):
@@ -558,6 +652,7 @@ class TpuBfsChecker(Checker):
             "queue this restore needs",
             self._model,
             self._A,
+            self._symmetry_enabled,
         )
         self._state_count = payload["state_count"]
         self._unique_count = payload["unique_count"]
@@ -566,14 +661,20 @@ class TpuBfsChecker(Checker):
         children = payload["children"]
         parents = payload["parents"]
         self._wave_log.append((children, parents))
+        # Visited-set keys == the original fps unless symmetry was on (then
+        # the checkpoint carries the orbit-key stream separately).
+        keys = children
+        if self._symmetry_enabled:
+            keys = payload["keys"]
+            self._key_log.append(keys)
 
         # Rebuild the device visited set by claim-inserting all known keys.
         self._capacity = max(self._capacity, payload["capacity"])
         table = hashset_new(self._capacity)
-        hi = (children >> np.uint64(32)).astype(np.uint32)
-        lo = (children & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+        hi = (keys >> np.uint64(32)).astype(np.uint32)
+        lo = (keys & np.uint64(0xFFFFFFFF)).astype(np.uint32)
         batch = 1 << 16
-        for start in range(0, len(children), batch):
+        for start in range(0, len(keys), batch):
             bh = jnp.asarray(hi[start : start + batch])
             bl = jnp.asarray(lo[start : start + batch])
             active = jnp.ones((bh.shape[0],), bool)
@@ -599,6 +700,10 @@ class TpuBfsChecker(Checker):
         self._wave_log.append(
             ((hi << np.uint64(32)) | lo, (phi << np.uint64(32)) | plo)
         )
+        if self._symmetry_enabled:
+            khi = np.asarray(wave["key_hi"])[:n_new].astype(np.uint64)
+            klo = np.asarray(wave["key_lo"])[:n_new].astype(np.uint64)
+            self._key_log.append((khi << np.uint64(32)) | klo)
 
     def _enqueue(self, queue, wave, n_new, B):
         target = -(-B // self._F_max) * self._F_max

@@ -24,8 +24,30 @@ from __future__ import annotations
 from typing import Any, Callable, List, Tuple
 
 import jax
+import numpy as np
 
 PackedState = Any  # pytree of arrays
+
+# Beyond this the n! permutation table dwarfs any state-space saving.
+MAX_SYMMETRY_ACTORS = 8
+
+
+def permutation_tables(n: int) -> Tuple[np.ndarray, np.ndarray]:
+    """All ``n!`` permutations as two aligned ``(n!, n)`` int32 tables:
+    ``new_to_old`` rows index-gather permuted vectors
+    (``permuted[new] = orig[new_to_old[new]]``) and ``old_to_new`` rows are
+    the inverses, used to rewrite embedded actor ids. Device symmetry takes
+    the minimum fingerprint over every row — a true orbit invariant."""
+    from itertools import permutations
+
+    if n > MAX_SYMMETRY_ACTORS:
+        raise ValueError(
+            f"symmetry over {n} actors needs a {n}!-row permutation table; "
+            f"the supported bound is {MAX_SYMMETRY_ACTORS}"
+        )
+    new_to_old = np.array(list(permutations(range(n))), np.int32)
+    old_to_new = np.argsort(new_to_old, axis=1).astype(np.int32)
+    return new_to_old, old_to_new
 
 
 class BatchableModel:
@@ -85,6 +107,37 @@ class BatchableModel:
         (``/root/reference/src/actor/model_state.rs:86-97``).
         """
         return state
+
+    # -- symmetry (optional) -----------------------------------------------
+    #
+    # Device symmetry reduction is *orbit-proper*: the dedup key is the
+    # minimum fingerprint over every actor permutation, so two states are
+    # deduplicated iff they are genuinely in the same symmetry orbit. This
+    # is deliberately NOT the reference's sort-based representative
+    # (``src/checker/rewrite_plan.rs:81-106``): that heuristic is not a
+    # canonical form (sorting keys change under id rewriting), so its
+    # reduced counts depend on traversal order — measured on 2pc-5: DFS
+    # order 665 (the reference's pinned number), BFS order 508, random
+    # orders 707-757. A wave-BFS device checker cannot reproduce a
+    # DFS-order artifact; it instead pins the canonical orbit counts
+    # (2pc-5 = 314, 3-server lossy-duplicating Raft = 464), which are
+    # traversal- and engine-independent and strictly stronger reductions.
+
+    def packed_symmetry(self) -> Tuple[np.ndarray, np.ndarray]:
+        """Returns the ``(new_to_old, old_to_new)`` permutation tables of
+        the model's symmetry group (usually ``permutation_tables(n)``).
+        Implementing this (plus ``packed_apply_permutation``) opts the
+        model into device symmetry reduction."""
+        raise NotImplementedError
+
+    def packed_apply_permutation(
+        self, state: PackedState, new_to_old: jax.Array, old_to_new: jax.Array
+    ) -> PackedState:
+        """Traceable group action: applies one permutation row to a packed
+        state (gather index-keyed arrays by ``new_to_old``; rewrite embedded
+        actor ids through ``old_to_new``; re-canonicalize order-insensitive
+        components)."""
+        raise NotImplementedError
 
     # -- host interop ------------------------------------------------------
 

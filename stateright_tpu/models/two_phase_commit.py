@@ -43,8 +43,7 @@ class TwoPhaseState:
     tm_prepared: Tuple[bool, ...]
     msgs: FrozenSet[Tuple]
 
-    def representative(self) -> "TwoPhaseState":
-        plan = RewritePlan.from_values_to_sort(self.rm_state)
+    def _permuted(self, plan: RewritePlan) -> "TwoPhaseState":
         return TwoPhaseState(
             rm_state=tuple(plan.reindex(self.rm_state)),
             tm_state=self.tm_state,
@@ -54,6 +53,19 @@ class TwoPhaseState:
                 for m in self.msgs
             ),
         )
+
+    def representative(self) -> "TwoPhaseState":
+        # Reference-parity sort heuristic (``examples/2pc.rs:203-228``):
+        # NOT a canonical form — see orbit_representative.
+        return self._permuted(RewritePlan.from_values_to_sort(self.rm_state))
+
+    def orbit_representative(self) -> "TwoPhaseState":
+        """True orbit canonical form (see ``utils.rewrite.orbit_min``):
+        traversal-order-independent, matching the device checkers'
+        minimum-fingerprint symmetry semantics."""
+        from ..utils.rewrite import orbit_min
+
+        return orbit_min(len(self.rm_state), self._permuted)
 
 
 # Packed codes (uint32). Order matters only for the packed representation.
@@ -240,6 +252,37 @@ class TwoPhaseSys(Model, BatchableModel):
             lambda st: jnp.all(st["rm"] == 2),  # commit agreement
             lambda st: ~(jnp.any(st["rm"] == 3) & jnp.any(st["rm"] == 2)),
         ]
+
+    # -- symmetry (orbit-proper; see core/batch.py) ------------------------
+
+    def packed_symmetry(self):
+        from ..core.batch import permutation_tables
+
+        return permutation_tables(self.rm_count)
+
+    def packed_apply_permutation(self, state, new_to_old, old_to_new):
+        """RM-permutation group action: permute per-RM codes and the
+        RM-indexed bits of the prepared/message bitmasks (device analog of
+        the host ``TwoPhaseState`` rewrite)."""
+        import jax.numpy as jnp
+
+        n = self.rm_count
+        n2o = new_to_old.astype(jnp.uint32)
+
+        def permute_bits(mask):
+            bits = (mask >> n2o) & jnp.uint32(1)
+            return (bits << jnp.arange(n, dtype=jnp.uint32)).sum(
+                dtype=jnp.uint32
+            )
+
+        low_mask = jnp.uint32((1 << n) - 1)
+        return {
+            "rm": state["rm"][new_to_old],
+            "tm": state["tm"],
+            "prepared": permute_bits(state["prepared"]),
+            "msgs": permute_bits(state["msgs"] & low_mask)
+            | (state["msgs"] & ~low_mask),
+        }
 
     def pack_state(self, host_state: TwoPhaseState):
         n = self.rm_count

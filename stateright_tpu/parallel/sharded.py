@@ -54,6 +54,7 @@ from ..ops.hashset import hashset_insert, hashset_new
 from .base_mesh import default_mesh
 from ..checker.base import Checker
 from ..checker.tpu import (
+    _make_key_fn,
     atomic_pickle,
     checkpoint_header,
     validate_checkpoint_header,
@@ -154,6 +155,9 @@ class ShardedTpuBfsChecker(Checker):
         self._max_depth = 0
         self._discoveries_fp: Dict[str, int] = {}
         self._wave_log: List = []
+        # Under symmetry: the u64 visited-set keys claimed so far (the
+        # checkpoint rebuild needs them; original fps cannot be re-keyed).
+        self._key_log: List = []
         self._store = make_fingerprint_store()
         self._ingested = 0
         self._ingest_lock = threading.Lock()
@@ -192,7 +196,16 @@ class ShardedTpuBfsChecker(Checker):
         # Fingerprints go through the model's view hook (e.g. actor systems
         # exclude crash flags, mirroring the host state hash).
         self._fp_fn = lambda s: fingerprint_state(model.packed_fingerprint_view(s))
+        # Visited/routing keys: orbit-minimum fingerprints under symmetry
+        # reduction (see checker/tpu.py and core/batch.py).
+        self._symmetry_enabled = options._symmetry is not None
+        self._key_fn = _make_key_fn(model, self._fp_fn, options._symmetry)
         self._jit_fp_batch = jax.jit(jax.vmap(self._fp_fn))
+        self._jit_key_batch = (
+            jax.jit(jax.vmap(self._key_fn))
+            if self._symmetry_enabled
+            else self._jit_fp_batch
+        )
         self._jit_fp_single = jax.jit(self._fp_fn)
 
         self._handles = [
@@ -319,13 +332,19 @@ class ShardedTpuBfsChecker(Checker):
         )
         cvalid_flat = cvalid.reshape(B)
         chi, clo = jax.vmap(self._fp_fn)(cand_flat)
+        # Routing/visited keys (orbit-minimum fps under symmetry); frontier
+        # rows and parent pointers keep the ORIGINAL fingerprints below.
+        if self._symmetry_enabled:
+            khi, klo = jax.vmap(self._key_fn)(cand_flat)
+        else:
+            khi, klo = chi, clo
 
         # Local pre-dedup: only one lane per distinct key is routed, so the
         # owner-side exchange carries no intra-device duplicates.
-        _shi, _slo, sidx, uniq = _sort_dedup(chi, clo, cvalid_flat)
+        _shi, _slo, sidx, uniq = _sort_dedup(khi, klo, cvalid_flat)
         route = jnp.zeros((B,), bool).at[sidx].set(uniq)
         table_loc, fresh, overflow = self._route_insert(
-            table_loc, chi, clo, route
+            table_loc, khi, klo, route
         )
 
         # Compact fresh candidates into the local next-frontier slots.
@@ -356,6 +375,10 @@ class ShardedTpuBfsChecker(Checker):
             "parent_hi": hi[parent_row] * (jnp.arange(B) < fresh.sum()),
             "parent_lo": lo[parent_row] * (jnp.arange(B) < fresh.sum()),
         }
+        if self._symmetry_enabled:
+            # Claimed visited-set keys, for checkpoint table rebuild.
+            out["new_khi"] = zu.at[out_slot].set(khi, mode="drop")
+            out["new_klo"] = zu.at[out_slot].set(klo, mode="drop")
 
         hits, fhis, flos = [], [], []
         for i, p in enumerate(self._properties):
@@ -587,6 +610,10 @@ class ShardedTpuBfsChecker(Checker):
 
         init_np = jax.tree_util.tree_map(pad0, init)
         hi, lo = (np.asarray(a) for a in self._jit_fp_batch(init_np))
+        if self._symmetry_enabled:
+            khi, klo = (np.asarray(a) for a in self._jit_key_batch(init_np))
+        else:
+            khi, klo = hi, lo
         in_range = np.arange(width) < n0
         bound = np.asarray(
             jax.jit(jax.vmap(model.packed_within_boundary))(init_np)
@@ -599,7 +626,7 @@ class ShardedTpuBfsChecker(Checker):
                 table,
                 *(
                     jax.device_put(jnp.asarray(a), self._shard)
-                    for a in (hi, lo, valid)
+                    for a in (khi, klo, valid)
                 ),
             )
             if not int(np.asarray(out["overflow"]).sum()):
@@ -612,6 +639,11 @@ class ShardedTpuBfsChecker(Checker):
         self._unique_count = int(fresh.sum())
         child64 = (hi.astype(np.uint64) << np.uint64(32)) | lo.astype(np.uint64)
         self._wave_log.append((child64[fresh], np.zeros((fresh.sum(),), np.uint64)))
+        if self._symmetry_enabled:
+            key64 = (khi.astype(np.uint64) << np.uint64(32)) | klo.astype(
+                np.uint64
+            )
+            self._key_log.append(key64[valid])
 
         self._pool_append(
             {
@@ -641,7 +673,9 @@ class ShardedTpuBfsChecker(Checker):
         self._ingest_wave_log()
         children, parents = self._store.export()
         payload = {
-            **checkpoint_header("sharded", self._model, self._A),
+            **checkpoint_header(
+                "sharded", self._model, self._A, self._symmetry_enabled
+            ),
             "state_count": self._state_count,
             "unique_count": self._unique_count,
             "max_depth": self._max_depth,
@@ -654,6 +688,12 @@ class ShardedTpuBfsChecker(Checker):
                 jax.tree_util.tree_map(np.asarray, batch) for batch in pool
             ],
         }
+        if self._symmetry_enabled:
+            payload["keys"] = (
+                np.concatenate(self._key_log)
+                if self._key_log
+                else np.zeros((0,), np.uint64)
+            )
         atomic_pickle(path, payload)
 
     def _restore(self, path):
@@ -668,6 +708,7 @@ class ShardedTpuBfsChecker(Checker):
             "pool this restore needs",
             self._model,
             self._A,
+            self._symmetry_enabled,
         )
         self._state_count = payload["state_count"]
         self._unique_count = payload["unique_count"]
@@ -676,6 +717,11 @@ class ShardedTpuBfsChecker(Checker):
         children = payload["children"]
         parents = payload["parents"]
         self._wave_log.append((children, parents))
+        # Visited-set keys == the original fps unless symmetry was on.
+        keys = children
+        if self._symmetry_enabled:
+            keys = payload["keys"]
+            self._key_log.append(keys)
         for batch in payload["pool"]:
             self._pool_append(batch)
 
@@ -689,14 +735,14 @@ class ShardedTpuBfsChecker(Checker):
             # rebuild needs no growth rounds.
             self._cap_loc = max(self._cap_loc, payload["cap_loc"])
         need = _pow2ceil(
-            max(int(len(children) / (_MAX_LOAD * n)), self._cap_loc)
+            max(int(len(keys) / (_MAX_LOAD * n)), self._cap_loc)
         )
         self._cap_loc = need
         table = self._new_table()
-        hi = (children >> np.uint64(32)).astype(np.uint32)
-        lo = (children & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+        hi = (keys >> np.uint64(32)).astype(np.uint32)
+        lo = (keys & np.uint64(0xFFFFFFFF)).astype(np.uint32)
         W = n * (1 << 13)
-        for start in range(0, len(children), W):
+        for start in range(0, len(keys), W):
             bh = hi[start : start + W]
             bl = lo[start : start + W]
             m = len(bh)
@@ -739,6 +785,10 @@ class ShardedTpuBfsChecker(Checker):
         child64 = (hi.astype(np.uint64) << np.uint64(32)) | lo.astype(np.uint64)
         par64 = (phi.astype(np.uint64) << np.uint64(32)) | plo.astype(np.uint64)
         self._wave_log.append((child64[sel], par64[sel]))
+        if self._symmetry_enabled:
+            k_hi = np.asarray(wave["new_khi"]).astype(np.uint64)
+            k_lo = np.asarray(wave["new_klo"]).astype(np.uint64)
+            self._key_log.append(((k_hi << np.uint64(32)) | k_lo)[sel])
         self._pool_append(
             {
                 "states": jax.tree_util.tree_map(lambda x: x[sel], states),

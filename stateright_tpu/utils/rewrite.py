@@ -32,6 +32,28 @@ def canonical_sort_key(value) -> bytes:
     return stable_encode(value)
 
 
+def orbit_min(n: int, permuted_fn: Callable):
+    """True orbit canonical form: the minimum over all ``n!`` rewrite plans
+    of ``permuted_fn(plan)``, keyed by canonical byte encoding. Proper (one
+    representative per orbit), so symmetry-reduced counts are traversal- and
+    engine-independent — the host twin of the device checkers'
+    minimum-fingerprint symmetry key. Shares the device path's actor-count
+    bound (``n!`` group enumeration)."""
+    from itertools import permutations
+
+    from ..core.batch import MAX_SYMMETRY_ACTORS
+
+    if n > MAX_SYMMETRY_ACTORS:
+        raise ValueError(
+            f"orbit canonicalization over {n} actors enumerates {n}! "
+            f"permutations; the supported bound is {MAX_SYMMETRY_ACTORS}"
+        )
+    return min(
+        (permuted_fn(RewritePlan(list(p))) for p in permutations(range(n))),
+        key=canonical_sort_key,
+    )
+
+
 class RewritePlan:
     """Maps old actor indices (Ids) to new ones."""
 

@@ -1,0 +1,218 @@
+"""Device symmetry reduction: orbit-proper minimum-fingerprint keys.
+
+The reference's symmetry reduction sorts actor rows to pick a representative
+(``src/checker/rewrite_plan.rs:81-106``) — NOT a canonical form, because id
+rewriting perturbs the sorted rows. Its reduced counts are traversal-order
+artifacts: on 2pc-5 the pinned 665 is single-threaded-DFS-specific (BFS
+order yields 508, random orders 707-757 — measured), so no wave-BFS engine
+can reproduce it. The device checkers instead key the visited set on the
+MINIMUM fingerprint over every actor permutation: a true orbit invariant,
+giving engine- and traversal-independent counts that are also strictly
+stronger reductions (2pc-5: 314 orbits vs 665 heuristic classes; 3-server
+lossy-duplicating Raft: 464 vs 621). The host ``orbit_representative``
+provides the same semantics for host checkers, which these tests use for
+cross-engine parity.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from stateright_tpu.actor import Network
+from stateright_tpu.models.raft import RaftModelCfg
+from stateright_tpu.models.two_phase_commit import TwoPhaseSys
+
+# Brute-forced orbit counts (min over all permutations of every reachable
+# state, computed independently of any checker).
+TWO_PC_5_ORBITS = 314
+RAFT_DUP_LOSSY_ORBITS = 464
+
+
+def _tpu_sym(model, **kw):
+    kw.setdefault("frontier_capacity", 256)
+    kw.setdefault("table_capacity", 1 << 14)
+    checker = model.checker().symmetry().spawn_tpu_bfs(**kw).join()
+    assert checker.worker_error() is None
+    return checker
+
+
+def _raft_dup():
+    return RaftModelCfg(
+        server_count=3,
+        max_term=1,
+        lossy=True,
+        network=Network.new_unordered_duplicating(),
+    ).into_model()
+
+
+def test_2pc5_device_orbit_count():
+    checker = _tpu_sym(TwoPhaseSys(5))
+    assert checker.unique_state_count() == TWO_PC_5_ORBITS
+    checker.assert_properties()
+    assert set(checker.discoveries()) == {"abort agreement", "commit agreement"}
+
+
+def test_2pc5_sharded_orbit_count_matches():
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.array(jax.devices()[:8]), ("fp",))
+    checker = (
+        TwoPhaseSys(5)
+        .checker()
+        .symmetry()
+        .spawn_sharded_tpu_bfs(
+            mesh=mesh, frontier_per_device=64, table_capacity_per_device=1 << 10
+        )
+        .join()
+    )
+    assert checker.worker_error() is None
+    assert checker.unique_state_count() == TWO_PC_5_ORBITS
+    checker.assert_properties()
+
+
+def test_raft_device_orbit_count_and_host_parity():
+    dev = _tpu_sym(_raft_dup(), table_capacity=1 << 12)
+    assert dev.unique_state_count() == RAFT_DUP_LOSSY_ORBITS
+    # Host DFS with the orbit-proper representative agrees exactly — the
+    # cross-engine guarantee the sort heuristic cannot give.
+    host = (
+        _raft_dup()
+        .checker()
+        .symmetry_fn(lambda s: s.orbit_representative())
+        .spawn_dfs()
+        .join()
+    )
+    assert host.unique_state_count() == RAFT_DUP_LOSSY_ORBITS
+    assert set(dev.discoveries()) == {"leader elected", "stable leader"}
+    # Discovery paths replay through concrete (original-fingerprint) states.
+    for path in dev.discoveries().values():
+        assert len(path) >= 1
+
+
+def test_2pc4_host_orbit_parity():
+    host = (
+        TwoPhaseSys(4)
+        .checker()
+        .symmetry_fn(lambda s: s.orbit_representative())
+        .spawn_dfs()
+        .join()
+    )
+    dev = _tpu_sym(TwoPhaseSys(4))
+    assert host.unique_state_count() == dev.unique_state_count()
+
+
+def test_device_group_action_matches_host():
+    # The packed group action (gather + codec id rewrites + canonical
+    # re-sort) must agree with the host RewritePlan application on every
+    # reachable state x permutation — this is what makes the minimum over
+    # permutations a true orbit key on the device.
+    from itertools import permutations
+
+    from stateright_tpu.utils.rewrite import RewritePlan
+
+    model = RaftModelCfg(server_count=3, max_term=1, lossy=True).into_model()
+    n2o, o2n = model.packed_symmetry()
+    apply_all = jax.jit(
+        jax.vmap(
+            lambda s, a, b: model.packed_apply_permutation(s, a, b),
+            in_axes=(None, 0, 0),
+        ),
+        static_argnums=(),
+    )
+
+    from collections import deque
+
+    states = list(model.init_states())
+    seen = {hash(s) for s in states}
+    q = deque(states)
+    acts = []
+    while q:
+        s = q.popleft()
+        acts.clear()
+        model.actions(s, acts)
+        for a in acts:
+            ns = model.next_state(s, a)
+            if (
+                ns is not None
+                and model.within_boundary(ns)
+                and hash(ns) not in seen
+            ):
+                seen.add(hash(ns))
+                states.append(ns)
+                q.append(ns)
+    assert len(states) == 665
+
+    perms = list(permutations(range(3)))
+    for s in states[::7]:  # every 7th state: 96 states x 6 perms
+        packed = model.pack_state(s)
+        dev = apply_all(packed, np.asarray(n2o), np.asarray(o2n))
+        for k, p in enumerate(perms):
+            # packed_apply_permutation row k uses new_to_old = perms[k];
+            # the matching host plan maps old i -> position of i in p.
+            mapping = [0] * 3
+            for new, old in enumerate(p):
+                mapping[old] = new
+            host_permuted = model.pack_state(s._permuted(RewritePlan(mapping)))
+            got = {kk: np.asarray(v[k]) for kk, v in dev.items()}
+            for kk in host_permuted:
+                assert np.array_equal(
+                    got[kk], np.asarray(host_permuted[kk])
+                ), (kk, p, s)
+
+
+def test_symmetry_checkpoint_resume(tmp_path):
+    ckpt = tmp_path / "2pc4-sym.ckpt"
+    first = (
+        TwoPhaseSys(4)
+        .checker()
+        .symmetry()
+        .target_state_count(150)
+        .spawn_tpu_bfs(
+            frontier_capacity=64,
+            checkpoint_path=str(ckpt),
+            checkpoint_every_chunks=1,
+        )
+        .join()
+    )
+    assert first.worker_error() is None
+    assert ckpt.exists()
+
+    full = _tpu_sym(TwoPhaseSys(4), frontier_capacity=64)
+    resumed = (
+        TwoPhaseSys(4)
+        .checker()
+        .symmetry()
+        .spawn_tpu_bfs(frontier_capacity=64, resume_from=str(ckpt))
+        .join()
+    )
+    assert resumed.worker_error() is None
+    assert resumed.unique_state_count() == full.unique_state_count()
+
+    # A symmetry checkpoint cannot resume a non-symmetry run (the visited
+    # keys live in different spaces).
+    mismatched = TwoPhaseSys(4).checker().spawn_tpu_bfs(
+        frontier_capacity=64, resume_from=str(ckpt)
+    )
+    with pytest.raises(RuntimeError):
+        mismatched.join()
+    assert "symmetry" in str(mismatched.worker_error())
+
+
+def test_custom_symmetry_fn_rejected_on_device():
+    # Device symmetry reduces by the FULL permutation group; honoring a
+    # user's partial-symmetry representative is impossible, so it must
+    # refuse instead of silently over-merging states.
+    with pytest.raises(ValueError):
+        TwoPhaseSys(3).checker().symmetry_fn(
+            lambda s: s.representative()
+        ).spawn_tpu_bfs()
+
+
+def test_symmetry_requires_packed_support():
+    # Models whose packed form cannot permute actors (auxiliary history
+    # carries distinguished client identities) refuse loudly.
+    from stateright_tpu.models.paxos import PaxosModelCfg
+
+    with pytest.raises(TypeError):
+        PaxosModelCfg(2, 2).into_model().checker().symmetry().spawn_tpu_bfs()
