@@ -175,6 +175,33 @@ def run_bench(pin_cpu: bool):
         f"({paxos_warm:.2f}s warmup) = {paxos_rate:,.0f}/s steady-state"
     )
 
+    # Tertiary: the BASELINE.md 5-node Raft config (leader-election
+    # liveness, lossy network) — a TPU-scale space (>300k states by depth
+    # 7), explored up to a generated-state cap so the bench stays bounded.
+    from stateright_tpu.models.raft import RaftModelCfg
+
+    RAFT_CAP = 300_000
+    t0 = time.time()
+    raft = (
+        RaftModelCfg(server_count=5, max_term=1, lossy=True)
+        .into_model()
+        .checker()
+        .target_state_count(RAFT_CAP)
+        .spawn_tpu_bfs(frontier_capacity=1 << 12, table_capacity=1 << 20)
+        .join()
+    )
+    raft_dt = time.time() - t0
+    err = raft.worker_error()
+    if err is not None:
+        raise err
+    raft_warm = raft.warmup_seconds or 0.0
+    raft_rate = raft.unique_state_count() / max(raft_dt - raft_warm, 1e-9)
+    log(
+        f"TpuBfs raft-5 lossy (capped {RAFT_CAP} generated): "
+        f"{raft.unique_state_count()} unique in {raft_dt:.2f}s wall "
+        f"({raft_warm:.2f}s warmup) = {raft_rate:,.0f}/s steady-state"
+    )
+
     print(
         json.dumps(
             {
@@ -188,6 +215,9 @@ def run_bench(pin_cpu: bool):
                 "warmup_s": round(warmup, 2),
                 "paxos_2c3s_rate": round(paxos_rate, 1),
                 "paxos_2c3s_wall_s": round(paxos_dt, 2),
+                "raft5_lossy_rate": round(raft_rate, 1),
+                "raft5_lossy_unique": raft.unique_state_count(),
+                "raft5_lossy_wall_s": round(raft_dt, 2),
                 "device": device.platform,
             }
         )

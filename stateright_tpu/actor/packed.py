@@ -9,12 +9,14 @@ staged transition function"):
 
 - **actor rows**: per-actor state packs into a ``(N, R)`` u32 matrix;
 - **network table**: unordered nets use a bounded ``(E,)``-slot envelope
-  table (src, dst, msg words, count) kept *canonically sorted* so identical
-  envelope multisets produce identical arrays (the host hashes networks
-  order-insensitively; sorting is the device analog); ordered nets use per
-  directed-pair FIFO queues ``(N², Q, W)`` with the head always at index 0
-  (shift-on-consume keeps the arrays canonical) — the device analog of the
-  reference's ``BTreeMap<(src,dst), VecDeque>`` flows
+  table (src, dst, msg words, count); identical envelope multisets
+  fingerprint identically because the fingerprint view reduces the table
+  to an order-insensitive multiset digest (the host hashes networks
+  order-insensitively; the commutative digest is the device analog — no
+  per-transition sort). Ordered nets use per directed-pair FIFO queues
+  ``(N², Q, W)`` with the head always at index 0 (shift-on-consume keeps
+  the arrays canonical) — the device analog of the reference's
+  ``BTreeMap<(src,dst), VecDeque>`` flows
   (``/root/reference/src/actor/network.rs:46-68``);
 - **timers**: one bitmask word per actor;
 - **crash faults**: a ``(N,)`` crashed vector when ``max_crashes`` is set;
@@ -396,42 +398,32 @@ class PackedActorModel(ActorModel, BatchableModel):
     # -- traceable transition ----------------------------------------------
 
     def packed_fingerprint_view(self, state):
-        """Crash flags are excluded from the fingerprint, mirroring the
-        host state hash (reference ``src/actor/model_state.rs:86-97``)."""
-        if "crashed" not in state:
-            return state
-        return {k: v for k, v in state.items() if k != "crashed"}
+        """The fingerprintable view of a packed system state:
 
-    def _canonicalize(self, state):
-        """Zeroes empty slots and sorts the envelope table so equal
-        multisets produce identical arrays (device analog of the host's
-        order-insensitive network hash). Ordered flows are positionally
-        canonical already (head always at slot 0)."""
-        import jax
+        - crash flags are excluded, mirroring the host state hash
+          (reference ``src/actor/model_state.rs:86-97``);
+        - the unordered envelope table is reduced to an order-insensitive
+          multiset digest (``ops.fingerprint.multiset_digest``), so equal
+          envelope multisets fingerprint identically WITHOUT the table
+          being kept sorted — transitions and symmetry permutations never
+          pay a per-candidate sort. Ordered flows are positionally
+          canonical (head at slot 0) and hash as-is.
+        """
         import jax.numpy as jnp
 
-        if self._ordered:
-            return state
-        W = self.codec.msg_width
-        cnt = state["net_cnt"]
-        empty = cnt == 0
-        z = jnp.uint32(0)
-        src = jnp.where(empty, z, state["net_src"])
-        dst = jnp.where(empty, z, state["net_dst"])
-        msg = jnp.where(empty[:, None], z, state["net_msg"])
-        cnt = jnp.where(empty, z, cnt)
-        operands = [empty.astype(jnp.uint32), src, dst]
-        operands += [msg[:, w] for w in range(W)]
-        operands += [cnt]
-        out = jax.lax.sort(tuple(operands), num_keys=len(operands))
-        src, dst = out[1], out[2]
-        msg = jnp.stack(out[3 : 3 + W], axis=1) if W else msg
-        cnt = out[3 + W]
-        state = dict(state)  # extra keys (e.g. "hist") pass through untouched
-        state.update(
-            net_src=src, net_dst=dst, net_msg=msg, net_cnt=cnt
-        )
-        return state
+        from ..ops.fingerprint import multiset_digest
+
+        out = {k: v for k, v in state.items() if k != "crashed"}
+        if not self._ordered:
+            src = out.pop("net_src")
+            dst = out.pop("net_dst")
+            msg = out.pop("net_msg")
+            cnt = out.pop("net_cnt")
+            rows = jnp.concatenate(
+                [src[:, None], dst[:, None], msg, cnt[:, None]], axis=1
+            ).astype(jnp.uint32)
+            out["net_digest"] = multiset_digest(rows, cnt > 0)
+        return out
 
     # -- symmetry (orbit-proper; see core/batch.py) ------------------------
 
@@ -491,7 +483,8 @@ class PackedActorModel(ActorModel, BatchableModel):
             )(state["net_msg"])
             msg = jnp.where(occ[:, None], msg, jnp.uint32(0))
             out.update(net_src=src, net_dst=dst, net_msg=msg)
-            out = self._canonicalize(out)
+            # No re-sort needed: the fingerprint view digests the envelope
+            # table order-insensitively.
         return out
 
     def _net_send(self, state, src, dst, msg, active):
@@ -814,8 +807,6 @@ class PackedActorModel(ActorModel, BatchableModel):
         valid = (
             valid_deliver | valid_drop | valid_timeout | valid_crash
         ) & ~overflow
-        # Guard: an invalid lane must still produce canonical arrays.
-        out = self._canonicalize(out)
         return out, valid
 
     def packed_conditions(self):

@@ -43,7 +43,7 @@ from ..core.batch import BatchableModel
 from ..core.model import Expectation
 from ..core.path import Path
 from ..native import make_fingerprint_store
-from ..ops.fingerprint import fingerprint_state, fp_to_int
+from ..ops.fingerprint import FP_SCHEME, fingerprint_state, fp64_pairs, fp_to_int
 from ..ops.hashset import hashset_insert, hashset_new
 from .base import Checker
 
@@ -80,6 +80,7 @@ def checkpoint_header(
         "model": type(model).__name__,
         "model_digest": packed_model_digest(model, action_count),
         "symmetry": symmetry,
+        "fp_scheme": FP_SCHEME,
     }
 
 
@@ -119,6 +120,12 @@ def validate_checkpoint_header(
             "(visited keys are orbit-minimum fingerprints under symmetry, "
             "plain fingerprints otherwise; the two key spaces cannot mix)"
         )
+    if payload.get("fp_scheme") != FP_SCHEME:
+        raise ValueError(
+            f"checkpoint fingerprint scheme {payload.get('fp_scheme')!r} "
+            f"does not match this build ({FP_SCHEME!r}); its visited keys "
+            "and parent fps cannot be mixed into a resumed run"
+        )
 
 
 def atomic_pickle(path, payload) -> None:
@@ -134,10 +141,14 @@ def atomic_pickle(path, payload) -> None:
 
 
 def _make_key_fn(model, fp_fn, symmetry):
-    """Dedup-key function for the device checkers: ``fp_fn`` itself, or the
-    orbit-minimum fingerprint when symmetry reduction is requested."""
+    """Batched dedup-key function for the device checkers, or ``None`` when
+    symmetry is off (callers then use the plain fingerprints they already
+    computed). Under symmetry the key is the orbit-minimum fingerprint,
+    computed as a sequential ``fori_loop`` over the ``n!`` permutations with
+    a lane-vectorized fingerprint pass per iteration — vmapping the group
+    axis instead would materialize ``B x n!`` permuted states at once."""
     if symmetry is None:
-        return fp_fn
+        return None
     from .builder import default_representative
 
     if symmetry is not default_representative:
@@ -157,17 +168,26 @@ def _make_key_fn(model, fp_fn, symmetry):
         ) from e
     n2o = jnp.asarray(n2o)
     o2n = jnp.asarray(o2n)
+    n_perms = n2o.shape[0]
 
-    def orbit_key(s):
-        his, los = jax.vmap(
-            lambda a, b: fp_fn(model.packed_apply_permutation(s, a, b))
-        )(n2o, o2n)
-        # Lexicographic (hi, lo) minimum without sorting the n! pairs.
-        mhi = his.min()
-        mlo = jnp.where(his == mhi, los, _U32_MAX).min()
-        return mhi, mlo
+    def orbit_keys(states_batch):
+        leaves = jax.tree_util.tree_leaves(states_batch)
+        b = leaves[0].shape[0]
 
-    return orbit_key
+        def body(k, acc):
+            mhi, mlo = acc
+            his, los = jax.vmap(
+                lambda s: fp_fn(
+                    model.packed_apply_permutation(s, n2o[k], o2n[k])
+                )
+            )(states_batch)
+            better = (his < mhi) | ((his == mhi) & (los < mlo))
+            return jnp.where(better, his, mhi), jnp.where(better, los, mlo)
+
+        full = jnp.full((b,), _U32_MAX)
+        return jax.lax.fori_loop(0, n_perms, body, (full, full))
+
+    return orbit_keys
 
 
 def _pow2ceil(n: int) -> int:
@@ -191,6 +211,9 @@ class TpuBfsChecker(Checker):
         checkpoint_every_chunks=32,
         checkpoint_min_interval_s=0.0,
         resume_from=None,
+        profile_dir=None,
+        max_drain_waves=256,
+        drain_log_factor=8,
     ):
         model = options.model
         if not isinstance(model, BatchableModel):
@@ -232,6 +255,15 @@ class TpuBfsChecker(Checker):
         self._checkpoint_every = max(1, checkpoint_every_chunks)
         self._checkpoint_min_interval = checkpoint_min_interval_s
         self._resume_from = resume_from
+        # SURVEY §5: per-frontier-wave profiler hooks. When set, the run is
+        # wrapped in a JAX profiler trace (viewable in TensorBoard /
+        # Perfetto) and every wave gets a StepTraceAnnotation.
+        self._profile_dir = profile_dir
+        # Multi-wave device drain: up to this many waves run per host round
+        # trip when frontiers stay narrow (1 = one wave per round trip).
+        # Disabled automatically when a visitor needs per-chunk callbacks.
+        self._max_drain_waves = max(1, max_drain_waves)
+        self._drain_log_capacity = max(1, drain_log_factor) * self._F_max
 
         self._state_count = 0
         self._unique_count = 0
@@ -261,6 +293,7 @@ class TpuBfsChecker(Checker):
         self._symmetry_enabled = options._symmetry is not None
         self._key_fn = _make_key_fn(model, self._fp_fn, options._symmetry)
         self._jit_wave = jax.jit(self._wave)
+        self._jit_drain = jax.jit(self._drain)
         self._jit_init = jax.jit(self._init_wave)
         self._jit_take = jax.jit(self._take, static_argnums=(2,))
         self._jit_finish = jax.jit(self._finish, static_argnums=(2,))
@@ -279,7 +312,7 @@ class TpuBfsChecker(Checker):
         valid = jax.vmap(self._model.packed_within_boundary)(states)
         hi, lo = jax.vmap(self._fp_fn)(states)
         if self._symmetry_enabled:
-            khi, klo = jax.vmap(self._key_fn)(states)
+            khi, klo = self._key_fn(states)
         else:
             khi, klo = hi, lo
         n0 = hi.shape[0]
@@ -343,7 +376,7 @@ class TpuBfsChecker(Checker):
         # so paths replay through concrete states (the reference keeps
         # original fps under symmetry too, src/checker/dfs.rs:300-309).
         if self._symmetry_enabled:
-            khi, klo = jax.vmap(self._key_fn)(cand_flat)
+            khi, klo = self._key_fn(cand_flat)
         else:
             khi, klo = chi, clo
         shi = jnp.where(cvalid_flat, khi, _U32_MAX)
@@ -426,6 +459,145 @@ class TpuBfsChecker(Checker):
         )
         return out
 
+    def _drain(
+        self, table, states, hi, lo, ebits, depth, mask, undiscovered, budget, depth_cap
+    ):
+        """Runs consecutive BFS waves entirely on device while each wave's
+        result is *consumable* without host help: the fresh frontier fits in
+        ``F_max`` lanes, the visited set has insert budget for another full
+        wave, the device log buffer has room, no undiscovered property hit,
+        and no hash overflow. This amortizes the host↔device round trip
+        (stats pull + chunk re-queue) over up to ``max_drain_waves`` waves —
+        the round trip dominates wall clock on narrow-frontier models once
+        expansion itself is fast (SURVEY §7-5c's host-loop concern).
+
+        Returns the final (unconsumed) wave output, the frontier that
+        produced it (for overflow retry), accumulated totals for the
+        consumed waves, and their (child, parent[, key]) log entries.
+        """
+        F, A = self._F_max, self._A
+        B = F * A
+        L = self._drain_log_capacity
+        P = len(self._properties)
+
+        def wave_of(tbl, fr):
+            return self._wave(
+                tbl,
+                fr["states"],
+                fr["hi"],
+                fr["lo"],
+                fr["ebits"],
+                fr["depth"],
+                fr["mask"],
+                depth_cap,
+            )
+
+        frontier0 = {
+            "states": states,
+            "hi": hi,
+            "lo": lo,
+            "ebits": ebits,
+            "depth": depth,
+            "mask": mask,
+        }
+        out0 = wave_of(table, frontier0)
+        zl = jnp.zeros((L,), jnp.uint32)
+        log0 = {
+            "child_hi": zl,
+            "child_lo": zl,
+            "parent_hi": zl,
+            "parent_lo": zl,
+        }
+        if self._symmetry_enabled:
+            log0.update(key_hi=zl, key_lo=zl)
+        carry = {
+            "frontier": frontier0,
+            "out": out0,
+            "log": log0,
+            "log_n": jnp.int32(0),
+            "generated": jnp.int32(0),
+            "consumed_unique": jnp.int32(0),
+            "max_depth": jnp.int32(0),
+            "budget": budget,
+            "waves": jnp.int32(0),
+        }
+
+        def cond(c):
+            o = c["out"]
+            n_new = o["n_new"]
+            ok = (n_new > 0) & (n_new <= F)
+            ok &= o["overflow"] == 0
+            if P:
+                ok &= ~(o["prop_hit"] & undiscovered).any()
+            ok &= c["log_n"] + n_new <= L
+            # Insert budget must survive consuming this wave plus another
+            # full worst-case wave (B candidates).
+            ok &= c["budget"] - n_new >= B
+            ok &= c["waves"] < self._max_drain_waves
+            return ok
+
+        def body(c):
+            o = c["out"]
+            n_new = o["n_new"]
+            new = o["new"]
+            lanes = jnp.arange(F, dtype=jnp.int32)
+            valid = lanes < n_new
+            slot = jnp.where(valid, c["log_n"] + lanes, L)
+            log = dict(c["log"])
+            log["child_hi"] = log["child_hi"].at[slot].set(
+                new["hi"][:F], mode="drop"
+            )
+            log["child_lo"] = log["child_lo"].at[slot].set(
+                new["lo"][:F], mode="drop"
+            )
+            log["parent_hi"] = log["parent_hi"].at[slot].set(
+                o["parent_hi"][:F], mode="drop"
+            )
+            log["parent_lo"] = log["parent_lo"].at[slot].set(
+                o["parent_lo"][:F], mode="drop"
+            )
+            if self._symmetry_enabled:
+                log["key_hi"] = log["key_hi"].at[slot].set(
+                    o["key_hi"][:F], mode="drop"
+                )
+                log["key_lo"] = log["key_lo"].at[slot].set(
+                    o["key_lo"][:F], mode="drop"
+                )
+            frontier = {
+                "states": jax.tree_util.tree_map(
+                    lambda x: x[:F], new["states"]
+                ),
+                "hi": new["hi"][:F],
+                "lo": new["lo"][:F],
+                "ebits": new["ebits"][:F],
+                "depth": new["depth"][:F],
+                "mask": valid,
+            }
+            return {
+                "frontier": frontier,
+                "out": wave_of(o["table"], frontier),
+                "log": log,
+                "log_n": c["log_n"] + n_new,
+                "generated": c["generated"] + o["generated"],
+                "consumed_unique": c["consumed_unique"] + n_new,
+                "max_depth": jnp.maximum(c["max_depth"], o["max_depth"]),
+                "budget": c["budget"] - n_new,
+                "waves": c["waves"] + 1,
+            }
+
+        res = jax.lax.while_loop(cond, body, carry)
+        # One consolidated transfer for the consumed-wave bookkeeping.
+        res["drain_stats"] = jnp.stack(
+            [
+                res["log_n"],
+                res["generated"],
+                res["consumed_unique"],
+                res["max_depth"],
+                res["waves"],
+            ]
+        )
+        return res
+
     def _take(self, arrs, start, size):
         return jax.tree_util.tree_map(
             lambda x: jax.lax.dynamic_slice_in_dim(x, start, size, axis=0), arrs
@@ -463,7 +635,14 @@ class TpuBfsChecker(Checker):
 
     def _run(self):
         try:
-            self._explore()
+            if self._profile_dir:
+                jax.profiler.start_trace(self._profile_dir)
+                try:
+                    self._explore()
+                finally:
+                    jax.profiler.stop_trace()
+            else:
+                self._explore()
         except BaseException as e:  # noqa: BLE001 - surfaced via worker_error
             self._error = e
         finally:
@@ -521,22 +700,88 @@ class TpuBfsChecker(Checker):
                     table, _pow2ceil(int((self._unique_count + B) / _MAX_LOAD))
                 )
 
+            # Multi-wave device drain (off when a visitor needs per-chunk
+            # callbacks, or when a target caps the count — overshoot would
+            # span whole drains instead of single waves).
+            use_drain = (
+                self._max_drain_waves > 1
+                and self._visitor is None
+                and self._target_state_count is None
+            )
+            wave = None
+            if use_drain:
+                undiscovered = np.array(
+                    [p.name not in self._discoveries_fp for p in props]
+                )
+                budget = jnp.int32(
+                    int(_MAX_LOAD * self._capacity) - self._unique_count
+                )
+                with jax.profiler.StepTraceAnnotation(
+                    "tpu_bfs.drain", step_num=chunks
+                ):
+                    res = self._jit_drain(
+                        table,
+                        chunk["states"],
+                        chunk["hi"],
+                        chunk["lo"],
+                        chunk["ebits"],
+                        chunk["depth"],
+                        chunk["mask"],
+                        jnp.asarray(undiscovered),
+                        budget,
+                        depth_cap,
+                    )
+                    dstats = np.asarray(res["drain_stats"])
+                if self.warmup_seconds is None:
+                    self.warmup_seconds = time.perf_counter() - t_start
+                log_n = int(dstats[0])
+                self._state_count += int(dstats[1])
+                self._unique_count += int(dstats[2])
+                self._max_depth = max(self._max_depth, int(dstats[3]))
+                if log_n:
+                    log = res["log"]
+                    self._wave_log.append(
+                        (
+                            fp64_pairs(
+                                log["child_hi"][:log_n], log["child_lo"][:log_n]
+                            ),
+                            fp64_pairs(
+                                log["parent_hi"][:log_n],
+                                log["parent_lo"][:log_n],
+                            ),
+                        )
+                    )
+                    if self._symmetry_enabled:
+                        self._key_log.append(
+                            fp64_pairs(
+                                log["key_hi"][:log_n], log["key_lo"][:log_n]
+                            )
+                        )
+                    # Consumed frontiers never left the device: re-queue
+                    # nothing — they were fully expanded in the drain.
+                wave = res["out"]
+                chunk = res["frontier"]  # the pending wave's input, for retry
+
             attempt = 0
             while True:
-                wave = self._jit_wave(
-                    table,
-                    chunk["states"],
-                    chunk["hi"],
-                    chunk["lo"],
-                    chunk["ebits"],
-                    chunk["depth"],
-                    chunk["mask"],
-                    depth_cap,
-                )
+                if wave is None:
+                    with jax.profiler.StepTraceAnnotation(
+                        "tpu_bfs.wave", step_num=chunks
+                    ):
+                        wave = self._jit_wave(
+                            table,
+                            chunk["states"],
+                            chunk["hi"],
+                            chunk["lo"],
+                            chunk["ebits"],
+                            chunk["depth"],
+                            chunk["mask"],
+                            depth_cap,
+                        )
                 table = wave["table"]
-                # Single host transfer per wave: [generated, n_new, overflow,
-                # max_depth, any_prop_hit?]; per-property fingerprints are
-                # pulled only on a hit.
+                # Single host transfer per wave: [generated, n_new,
+                # overflow, max_depth, any_prop_hit?]; per-property
+                # fingerprints are pulled only on a hit.
                 stats = np.asarray(wave["stats"])
                 if self.warmup_seconds is None:
                     self.warmup_seconds = time.perf_counter() - t_start
@@ -563,6 +808,7 @@ class TpuBfsChecker(Checker):
                     break
                 table = self._grow_table(table, self._capacity * 2)
                 attempt += 1
+                wave = None
 
     def _seed(self):
         """Inserts + enqueues the initial states; returns (table, queue)."""
@@ -579,14 +825,10 @@ class TpuBfsChecker(Checker):
         hi = np.asarray(out["hi"])
         lo = np.asarray(out["lo"])
         valid = np.asarray(out["valid"])
-        child64 = ((hi.astype(np.uint64) << np.uint64(32)) | lo.astype(np.uint64))[
-            valid
-        ]
+        child64 = fp64_pairs(hi, lo)[valid]
         self._wave_log.append((child64, np.zeros_like(child64)))
         if self._symmetry_enabled:
-            k_hi = np.asarray(out["khi"]).astype(np.uint64)
-            k_lo = np.asarray(out["klo"]).astype(np.uint64)
-            self._key_log.append(((k_hi << np.uint64(32)) | k_lo)[valid])
+            self._key_log.append(fp64_pairs(out["khi"], out["klo"])[valid])
 
         F0 = hi.shape[0]
         init_arrs = {
@@ -693,17 +935,16 @@ class TpuBfsChecker(Checker):
         return table, queue
 
     def _log_wave(self, wave, n_new):
-        hi = np.asarray(wave["new"]["hi"])[:n_new].astype(np.uint64)
-        lo = np.asarray(wave["new"]["lo"])[:n_new].astype(np.uint64)
-        phi = np.asarray(wave["parent_hi"])[:n_new].astype(np.uint64)
-        plo = np.asarray(wave["parent_lo"])[:n_new].astype(np.uint64)
         self._wave_log.append(
-            ((hi << np.uint64(32)) | lo, (phi << np.uint64(32)) | plo)
+            (
+                fp64_pairs(wave["new"]["hi"][:n_new], wave["new"]["lo"][:n_new]),
+                fp64_pairs(wave["parent_hi"][:n_new], wave["parent_lo"][:n_new]),
+            )
         )
         if self._symmetry_enabled:
-            khi = np.asarray(wave["key_hi"])[:n_new].astype(np.uint64)
-            klo = np.asarray(wave["key_lo"])[:n_new].astype(np.uint64)
-            self._key_log.append((khi << np.uint64(32)) | klo)
+            self._key_log.append(
+                fp64_pairs(wave["key_hi"][:n_new], wave["key_lo"][:n_new])
+            )
 
     def _enqueue(self, queue, wave, n_new, B):
         target = -(-B // self._F_max) * self._F_max

@@ -24,7 +24,13 @@ from typing import Any, List, Tuple
 import jax
 import jax.numpy as jnp
 
-__all__ = ["state_words", "fingerprint_words", "fingerprint_state", "fp_to_int"]
+__all__ = [
+    "state_words",
+    "fingerprint_words",
+    "fingerprint_state",
+    "fp_to_int",
+    "multiset_digest",
+]
 
 _C1 = 0xCC9E2D51
 _C2 = 0x1B873593
@@ -120,6 +126,50 @@ def fingerprint_state(state: Any) -> Tuple[jax.Array, jax.Array]:
     return fingerprint_words(state_words(state))
 
 
+def multiset_digest(rows: jax.Array, active: jax.Array) -> jax.Array:
+    """(4,) uint32 slot-order-insensitive digest of the active rows of a 2-D
+    uint32 table: per-row murmur under two seeds (row-parallel — the serial
+    chain is only W words long), combined by commutative reductions (sum and
+    xor per seed lane). The device analog of the host's order-insensitive
+    container hash (reference ``src/util.rs:137-159`` sorts element hashes;
+    a commutative combine is the vmappable equivalent SURVEY §7 calls for).
+    Models fold the digest into their fingerprint view instead of keeping
+    unordered tables canonically sorted — removing per-transition and
+    per-permutation sorts from the hot path."""
+    E, W = rows.shape
+    hi = jnp.full((E,), jnp.uint32(_SEED_HI))
+    lo = jnp.full((E,), jnp.uint32(_SEED_LO))
+    for w in range(W):
+        col = rows[:, w]
+        hi = _mm3_round(hi, col)
+        lo = _mm3_round(lo, col ^ jnp.uint32(0xA5A5A5A5))
+    hi = _fmix(hi ^ jnp.uint32(W * 4))
+    lo = _fmix(lo ^ jnp.uint32(W * 4 + 1))
+    hi = jnp.where(active, hi, jnp.uint32(0))
+    lo = jnp.where(active, lo, jnp.uint32(0))
+    xor_hi = jax.lax.reduce(hi, jnp.uint32(0), jax.lax.bitwise_xor, (0,))
+    xor_lo = jax.lax.reduce(lo, jnp.uint32(0), jax.lax.bitwise_xor, (0,))
+    return jnp.stack(
+        [hi.sum(dtype=jnp.uint32), xor_hi, lo.sum(dtype=jnp.uint32), xor_lo]
+    )
+
+
 def fp_to_int(hi, lo) -> int:
     """Host-side: a (hi, lo) pair as one python int fingerprint."""
     return (int(hi) << 32) | int(lo)
+
+
+def fp64_pairs(hi, lo):
+    """Host-side: (hi, lo) uint32 arrays combined into one uint64 array."""
+    import numpy as np
+
+    return (np.asarray(hi).astype(np.uint64) << np.uint64(32)) | np.asarray(
+        lo
+    ).astype(np.uint64)
+
+
+# Identifies the fingerprint definition (word layout + mixing). Checkpoints
+# record it: visited-set keys and parent-store fps from a different scheme
+# cannot be mixed into a resumed run. Bump on ANY change to the functions
+# above or to a model's fingerprint view encoding.
+FP_SCHEME = "mm3x2/msdigest-v2"

@@ -49,7 +49,7 @@ from ..core.batch import BatchableModel
 from ..core.model import Expectation
 from ..core.path import Path
 from ..native import make_fingerprint_store
-from ..ops.fingerprint import fingerprint_state, fp_to_int
+from ..ops.fingerprint import fingerprint_state, fp64_pairs, fp_to_int
 from ..ops.hashset import hashset_insert, hashset_new
 from .base_mesh import default_mesh
 from ..checker.base import Checker
@@ -202,7 +202,7 @@ class ShardedTpuBfsChecker(Checker):
         self._key_fn = _make_key_fn(model, self._fp_fn, options._symmetry)
         self._jit_fp_batch = jax.jit(jax.vmap(self._fp_fn))
         self._jit_key_batch = (
-            jax.jit(jax.vmap(self._key_fn))
+            jax.jit(self._key_fn)
             if self._symmetry_enabled
             else self._jit_fp_batch
         )
@@ -335,7 +335,7 @@ class ShardedTpuBfsChecker(Checker):
         # Routing/visited keys (orbit-minimum fps under symmetry); frontier
         # rows and parent pointers keep the ORIGINAL fingerprints below.
         if self._symmetry_enabled:
-            khi, klo = jax.vmap(self._key_fn)(cand_flat)
+            khi, klo = self._key_fn(cand_flat)
         else:
             khi, klo = chi, clo
 
@@ -637,13 +637,10 @@ class ShardedTpuBfsChecker(Checker):
         fresh = np.asarray(out["fresh"])
         self._state_count = int(valid.sum())
         self._unique_count = int(fresh.sum())
-        child64 = (hi.astype(np.uint64) << np.uint64(32)) | lo.astype(np.uint64)
+        child64 = fp64_pairs(hi, lo)
         self._wave_log.append((child64[fresh], np.zeros((fresh.sum(),), np.uint64)))
         if self._symmetry_enabled:
-            key64 = (khi.astype(np.uint64) << np.uint64(32)) | klo.astype(
-                np.uint64
-            )
-            self._key_log.append(key64[valid])
+            self._key_log.append(fp64_pairs(khi, klo)[valid])
 
         self._pool_append(
             {
@@ -782,13 +779,13 @@ class ShardedTpuBfsChecker(Checker):
         sel = np.zeros((self._n * B,), bool)
         for d in range(self._n):
             sel[d * B : d * B + int(n_new[d])] = True
-        child64 = (hi.astype(np.uint64) << np.uint64(32)) | lo.astype(np.uint64)
-        par64 = (phi.astype(np.uint64) << np.uint64(32)) | plo.astype(np.uint64)
+        child64 = fp64_pairs(hi, lo)
+        par64 = fp64_pairs(phi, plo)
         self._wave_log.append((child64[sel], par64[sel]))
         if self._symmetry_enabled:
-            k_hi = np.asarray(wave["new_khi"]).astype(np.uint64)
-            k_lo = np.asarray(wave["new_klo"]).astype(np.uint64)
-            self._key_log.append(((k_hi << np.uint64(32)) | k_lo)[sel])
+            self._key_log.append(
+                fp64_pairs(wave["new_khi"], wave["new_klo"])[sel]
+            )
         self._pool_append(
             {
                 "states": jax.tree_util.tree_map(lambda x: x[sel], states),

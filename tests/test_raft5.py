@@ -1,0 +1,88 @@
+"""The 5-node Raft baseline config (BASELINE.md: leader-election liveness,
+lossy network, symmetry reduction).
+
+The full 5-node lossy space is a TPU-scale workload (>300k states at depth 7
+and growing; it is benched, capped, in ``bench.py``). CI pins the exact
+tractable configs: the full 5-node lossless space on single-device and
+sharded checkers, plus symmetry-reduced orbit counts (orbit-proper device
+semantics — see ``tests/test_device_symmetry.py``) at 4 nodes (lossy) and
+5 nodes (lossless, the full 120-permutation group).
+"""
+
+import numpy as np
+
+import jax
+
+from stateright_tpu.models.raft import RaftModelCfg
+
+RAFT5_LOSSLESS = 7_977
+RAFT5_LOSSLESS_ORBITS = 123
+RAFT4_LOSSY = 24_545
+RAFT4_LOSSY_ORBITS = 1_181
+
+
+def test_raft5_lossless_device_and_sharded_parity():
+    dev = (
+        RaftModelCfg(server_count=5, max_term=1, lossy=False)
+        .into_model()
+        .checker()
+        .spawn_tpu_bfs(frontier_capacity=1 << 10, table_capacity=1 << 14)
+        .join()
+    )
+    assert dev.worker_error() is None
+    assert dev.unique_state_count() == RAFT5_LOSSLESS
+
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.array(jax.devices()[:8]), ("fp",))
+    sh = (
+        RaftModelCfg(server_count=5, max_term=1, lossy=False)
+        .into_model()
+        .checker()
+        .spawn_sharded_tpu_bfs(
+            mesh=mesh, frontier_per_device=128, table_capacity_per_device=1 << 11
+        )
+        .join()
+    )
+    assert sh.worker_error() is None
+    assert sh.unique_state_count() == RAFT5_LOSSLESS
+    # Liveness counterexample (split votes exhaust the term boundary
+    # leaderless) is discoverable at 5 nodes.
+    assert "stable leader" in dev.discoveries()
+
+
+def test_raft5_lossless_symmetry_orbits():
+    c = (
+        RaftModelCfg(server_count=5, max_term=1, lossy=False)
+        .into_model()
+        .checker()
+        .symmetry()
+        .spawn_tpu_bfs(frontier_capacity=1 << 10, table_capacity=1 << 14)
+        .join()
+    )
+    assert c.worker_error() is None
+    assert c.unique_state_count() == RAFT5_LOSSLESS_ORBITS
+
+
+def test_raft4_lossy_symmetry_orbits():
+    full = (
+        RaftModelCfg(server_count=4, max_term=1, lossy=True)
+        .into_model()
+        .checker()
+        .spawn_tpu_bfs(frontier_capacity=1 << 11, table_capacity=1 << 16)
+        .join()
+    )
+    assert full.worker_error() is None
+    assert full.unique_state_count() == RAFT4_LOSSY
+
+    reduced = (
+        RaftModelCfg(server_count=4, max_term=1, lossy=True)
+        .into_model()
+        .checker()
+        .symmetry()
+        .spawn_tpu_bfs(frontier_capacity=1 << 10, table_capacity=1 << 14)
+        .join()
+    )
+    assert reduced.worker_error() is None
+    assert reduced.unique_state_count() == RAFT4_LOSSY_ORBITS
+    assert set(reduced.discoveries()) == {"leader elected", "stable leader"}
