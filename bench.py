@@ -1,16 +1,25 @@
-"""Round benchmark: TPU BFS throughput on two-phase commit.
+"""Round benchmark: TPU BFS throughput on the reference bench workloads.
 
 Prints ONE JSON line:
     {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
 
-Workload: exhaustive check of the 7-RM two-phase-commit model
+Primary workload: exhaustive check of the 7-RM two-phase-commit model
 (296,448 unique states — the scaled-up version of the reference's
 ``2pc check N`` bench config, ``/root/reference/bench.sh:27``) on the
 ``TpuBfsChecker`` device backend. Baseline: the host ``BfsChecker`` on the
 same model, rate-sampled with a state-count cap so the bench stays fast;
 the reference itself publishes no absolute numbers (BASELINE.md).
 
-Diagnostics go to stderr; stdout carries only the JSON line.
+Secondary legs: paxos 2c/3s with the linearizability history checked on
+device per wave (reference flagship, ``examples/paxos.rs:325``), and the
+BASELINE.md 5-node lossy Raft at a depth cap.
+
+Each leg runs in its OWN subprocess with its own timeout: the device
+tunnel on this image is flaky and can wedge any single run; a wedged leg
+must cost only its own timeout, not the whole bench. Legs that fail on
+the accelerator are retried CPU-pinned so the line always carries at
+least a fallback number. Diagnostics go to stderr; stdout carries only
+the JSON line.
 """
 
 from __future__ import annotations
@@ -25,6 +34,7 @@ EXPECTED_UNIQUE = 296_448
 HOST_CAP = 30_000
 DEVICE_PROBE_TIMEOUT_S = 60
 DEVICE_PROBE_ATTEMPTS = 3
+LEG_TIMEOUT_S = {"2pc": 720, "paxos": 600, "raft5": 600}
 
 
 def log(*args):
@@ -61,167 +71,204 @@ def _accelerator_usable() -> bool:
     return False
 
 
-DEVICE_RUN_TIMEOUT_S = 900
-
-
-def main():
-    """Parent entry: tries the full bench on the accelerator in a subprocess
-    (the flaky tunnel can wedge mid-run, not just at init), falling back to
-    a CPU-pinned in-process run. The child prints the JSON line; the parent
-    relays it."""
-    if "--child" in sys.argv:
-        return run_bench(pin_cpu=False)
-    if _accelerator_usable():
-        try:
-            r = subprocess.run(
-                [sys.executable, __file__, "--child"],
-                timeout=DEVICE_RUN_TIMEOUT_S,
-                capture_output=True,
-            )
-        except subprocess.TimeoutExpired:
-            log(f"device bench run wedged after {DEVICE_RUN_TIMEOUT_S}s")
-        else:
-            sys.stderr.buffer.write(r.stderr[-4000:])
-            line = r.stdout.decode().strip().splitlines()
-            if r.returncode == 0 and line:
-                print(line[-1])
-                return
-            log(f"device bench run failed (rc={r.returncode})")
-    log("falling back to CPU backend")
-    run_bench(pin_cpu=True)
-
-
-def run_bench(pin_cpu: bool):
+def _run_leg(leg: str, pin_cpu: bool):
+    """Child entry: runs one leg, prints its result dict as a JSON line."""
     import jax
 
     if pin_cpu:
         # sitecustomize forces jax_platforms=axon,cpu via jax.config, which
         # overrides the JAX_PLATFORMS env var — re-pin through the config.
         jax.config.update("jax_platforms", "cpu")
-
-    from stateright_tpu.models.two_phase_commit import TwoPhaseSys
-
     device = jax.devices()[0]
-    log(f"bench device: {device.platform} ({device})")
+    log(f"[{leg}] device: {device.platform} ({device})")
+    out = {"device": device.platform}
 
-    t0 = time.time()
-    host = (
-        TwoPhaseSys(RM_COUNT)
-        .checker()
-        .target_state_count(HOST_CAP)
-        .spawn_bfs()
-        .join()
-    )
-    host_dt = time.time() - t0
-    host_rate = host.unique_state_count() / host_dt
-    log(
-        f"host BfsChecker: {host.unique_state_count()} unique "
-        f"in {host_dt:.2f}s = {host_rate:,.0f}/s (capped)"
-    )
+    if leg == "2pc":
+        from stateright_tpu.models.two_phase_commit import TwoPhaseSys
 
-    t0 = time.time()
-    checker = (
-        TwoPhaseSys(RM_COUNT)
-        .checker()
-        .spawn_tpu_bfs(frontier_capacity=1 << 13, table_capacity=1 << 20)
-        .join()
-    )
-    tpu_dt = time.time() - t0
-    err = checker.worker_error()
-    if err is not None:
-        raise err
-    unique = checker.unique_state_count()
-    if unique != EXPECTED_UNIQUE:
-        raise AssertionError(
-            f"2pc-{RM_COUNT} count mismatch: {unique} != {EXPECTED_UNIQUE}"
+        t0 = time.time()
+        host = (
+            TwoPhaseSys(RM_COUNT)
+            .checker()
+            .target_state_count(HOST_CAP)
+            .spawn_bfs()
+            .join()
         )
-    checker.assert_properties()
-    # Exclude one-time XLA compilation (the time until the first wave
-    # returned) so the metric reports steady-state exploration throughput.
-    warmup = checker.warmup_seconds or 0.0
-    steady = max(tpu_dt - warmup, 1e-9)
-    tpu_rate = unique / steady
-    log(
-        f"TpuBfs: {unique} unique in {tpu_dt:.2f}s wall "
-        f"({warmup:.2f}s compile warmup) = {tpu_rate:,.0f}/s steady-state"
-    )
-
-    # Secondary: the reference's flagship linearizability workload (paxos,
-    # 2 clients / 3 servers = 16,668 states, examples/paxos.rs:325) with the
-    # LinearizabilityTester history checked ON DEVICE per wave.
-    from stateright_tpu.models.paxos import PaxosModelCfg
-
-    t0 = time.time()
-    paxos = (
-        PaxosModelCfg(2, 3)
-        .into_model()
-        .checker()
-        .spawn_tpu_bfs(frontier_capacity=1 << 11, table_capacity=1 << 16)
-        .join()
-    )
-    paxos_dt = time.time() - t0
-    err = paxos.worker_error()
-    if err is not None:
-        raise err
-    if paxos.unique_state_count() != 16_668:
-        raise AssertionError(
-            f"paxos-2c3s count mismatch: {paxos.unique_state_count()} != 16668"
+        host_dt = time.time() - t0
+        out["host_rate"] = host.unique_state_count() / host_dt
+        log(
+            f"[2pc] host BfsChecker: {host.unique_state_count()} unique "
+            f"in {host_dt:.2f}s = {out['host_rate']:,.0f}/s (capped)"
         )
-    paxos.assert_properties()
-    paxos_warm = paxos.warmup_seconds or 0.0
-    paxos_rate = 16_668 / max(paxos_dt - paxos_warm, 1e-9)
-    log(
-        f"TpuBfs paxos-2c3s: 16668 unique in {paxos_dt:.2f}s wall "
-        f"({paxos_warm:.2f}s warmup) = {paxos_rate:,.0f}/s steady-state"
-    )
 
-    # Tertiary: the BASELINE.md 5-node Raft config (leader-election
-    # liveness, lossy network) — a TPU-scale space (>300k states by depth
-    # 7), explored up to a generated-state cap so the bench stays bounded.
-    from stateright_tpu.models.raft import RaftModelCfg
-
-    RAFT_CAP = 300_000
-    t0 = time.time()
-    raft = (
-        RaftModelCfg(server_count=5, max_term=1, lossy=True)
-        .into_model()
-        .checker()
-        .target_state_count(RAFT_CAP)
-        .spawn_tpu_bfs(frontier_capacity=1 << 12, table_capacity=1 << 20)
-        .join()
-    )
-    raft_dt = time.time() - t0
-    err = raft.worker_error()
-    if err is not None:
-        raise err
-    raft_warm = raft.warmup_seconds or 0.0
-    raft_rate = raft.unique_state_count() / max(raft_dt - raft_warm, 1e-9)
-    log(
-        f"TpuBfs raft-5 lossy (capped {RAFT_CAP} generated): "
-        f"{raft.unique_state_count()} unique in {raft_dt:.2f}s wall "
-        f"({raft_warm:.2f}s warmup) = {raft_rate:,.0f}/s steady-state"
-    )
-
-    print(
-        json.dumps(
-            {
-                "metric": f"2pc-{RM_COUNT} exhaustive unique states/sec (TpuBfs)",
-                "value": round(tpu_rate, 1),
-                "unit": "unique states/sec",
-                "vs_baseline": round(tpu_rate / host_rate, 3),
-                "baseline": "host BfsChecker (Python), same model, capped run",
-                "unique_states": unique,
-                "wall_s": round(tpu_dt, 2),
-                "warmup_s": round(warmup, 2),
-                "paxos_2c3s_rate": round(paxos_rate, 1),
-                "paxos_2c3s_wall_s": round(paxos_dt, 2),
-                "raft5_lossy_rate": round(raft_rate, 1),
-                "raft5_lossy_unique": raft.unique_state_count(),
-                "raft5_lossy_wall_s": round(raft_dt, 2),
-                "device": device.platform,
-            }
+        t0 = time.time()
+        checker = (
+            TwoPhaseSys(RM_COUNT)
+            .checker()
+            .spawn_tpu_bfs(
+                frontier_capacity=1 << 13,
+                table_capacity=1 << 20,
+                drain_log_factor=48,
+            )
+            .join()
         )
+        dt = time.time() - t0
+        err = checker.worker_error()
+        if err is not None:
+            raise err
+        unique = checker.unique_state_count()
+        if unique != EXPECTED_UNIQUE:
+            raise AssertionError(
+                f"2pc-{RM_COUNT} count mismatch: {unique} != {EXPECTED_UNIQUE}"
+            )
+        checker.assert_properties()
+        out.update(
+            unique=unique,
+            wall_s=dt,
+            warmup_s=checker.warmup_seconds or 0.0,
+            rate=unique / max(dt - (checker.warmup_seconds or 0.0), 1e-9),
+        )
+    elif leg == "paxos":
+        from stateright_tpu.models.paxos import PaxosModelCfg
+
+        t0 = time.time()
+        checker = (
+            PaxosModelCfg(2, 3)
+            .into_model()
+            .checker()
+            .spawn_tpu_bfs(frontier_capacity=1 << 11, table_capacity=1 << 16)
+            .join()
+        )
+        dt = time.time() - t0
+        err = checker.worker_error()
+        if err is not None:
+            raise err
+        if checker.unique_state_count() != 16_668:
+            raise AssertionError(
+                f"paxos-2c3s count mismatch: "
+                f"{checker.unique_state_count()} != 16668"
+            )
+        checker.assert_properties()
+        out.update(
+            unique=16_668,
+            wall_s=dt,
+            warmup_s=checker.warmup_seconds or 0.0,
+            rate=16_668 / max(dt - (checker.warmup_seconds or 0.0), 1e-9),
+        )
+    elif leg == "raft5":
+        from stateright_tpu.models.raft import RaftModelCfg
+
+        # Depth cap (not a state-count target) keeps the workload
+        # deterministic AND deep-drain-eligible. Frontier kept modest:
+        # raft-5 packs ~1.3KB/state and expands 125 actions/lane, so
+        # candidate buffers scale at ~0.17GB per 1024 lanes.
+        t0 = time.time()
+        checker = (
+            RaftModelCfg(server_count=5, max_term=1, lossy=True)
+            .into_model()
+            .checker()
+            .target_max_depth(6)
+            .spawn_tpu_bfs(frontier_capacity=1 << 10, table_capacity=1 << 20)
+            .join()
+        )
+        dt = time.time() - t0
+        err = checker.worker_error()
+        if err is not None:
+            raise err
+        out.update(
+            unique=checker.unique_state_count(),
+            wall_s=dt,
+            warmup_s=checker.warmup_seconds or 0.0,
+            rate=checker.unique_state_count()
+            / max(dt - (checker.warmup_seconds or 0.0), 1e-9),
+        )
+    else:
+        raise ValueError(f"unknown leg {leg!r}")
+    log(
+        f"[{leg}] {out.get('unique')} unique in {out.get('wall_s'):.2f}s "
+        f"wall ({out.get('warmup_s'):.2f}s warmup) = "
+        f"{out.get('rate'):,.0f}/s steady-state"
     )
+    print(json.dumps(out))
+
+
+def _leg_subprocess(leg: str, pin_cpu: bool):
+    """Runs one leg in a child; returns its result dict or None."""
+    argv = [sys.executable, __file__, "--leg", leg]
+    # CPU-pinned fallbacks get extra headroom: they exist so the bench
+    # always emits a number, and a slow host must not be killed like a
+    # wedged tunnel.
+    timeout_s = LEG_TIMEOUT_S[leg] * (3 if pin_cpu else 1)
+    if pin_cpu:
+        argv.append("--cpu")
+    try:
+        # stderr inherits the parent's stream: diagnostics (and OOM
+        # reports) surface live instead of dying with the child.
+        r = subprocess.run(argv, timeout=timeout_s, stdout=subprocess.PIPE)
+    except subprocess.TimeoutExpired:
+        log(f"[{leg}] wedged after {timeout_s}s")
+        return None
+    lines = r.stdout.decode().strip().splitlines()
+    if r.returncode == 0 and lines:
+        try:
+            return json.loads(lines[-1])
+        except json.JSONDecodeError:
+            pass
+    log(f"[{leg}] failed (rc={r.returncode})")
+    return None
+
+
+def main():
+    if "--leg" in sys.argv:
+        return _run_leg(
+            sys.argv[sys.argv.index("--leg") + 1], "--cpu" in sys.argv
+        )
+
+    on_accel = _accelerator_usable()
+    results = {}
+    for leg in ("2pc", "paxos", "raft5"):
+        res = _leg_subprocess(leg, pin_cpu=False) if on_accel else None
+        if res is None:
+            log(f"[{leg}] falling back to CPU-pinned run")
+            res = _leg_subprocess(leg, pin_cpu=True)
+        if res is not None:
+            results[leg] = res
+
+    if "2pc" not in results:
+        # Still emit the JSON line (the output contract) with an error
+        # marker rather than nothing.
+        print(
+            json.dumps(
+                {
+                    "metric": f"2pc-{RM_COUNT} exhaustive unique "
+                    "states/sec (TpuBfs)",
+                    "value": 0,
+                    "unit": "unique states/sec",
+                    "vs_baseline": 0,
+                    "error": "primary 2pc leg failed on every backend",
+                }
+            )
+        )
+        return
+    primary = results["2pc"]
+    line = {
+        "metric": f"2pc-{RM_COUNT} exhaustive unique states/sec (TpuBfs)",
+        "value": round(primary["rate"], 1),
+        "unit": "unique states/sec",
+        "vs_baseline": round(primary["rate"] / primary["host_rate"], 3),
+        "baseline": "host BfsChecker (Python), same model, capped run",
+        "unique_states": primary["unique"],
+        "wall_s": round(primary["wall_s"], 2),
+        "warmup_s": round(primary["warmup_s"], 2),
+        "device": primary["device"],
+    }
+    for leg in ("paxos", "raft5"):
+        if leg in results:
+            line[f"{leg}_rate"] = round(results[leg]["rate"], 1)
+            line[f"{leg}_unique"] = results[leg]["unique"]
+            line[f"{leg}_wall_s"] = round(results[leg]["wall_s"], 2)
+            line[f"{leg}_device"] = results[leg]["device"]
+    print(json.dumps(line))
 
 
 if __name__ == "__main__":

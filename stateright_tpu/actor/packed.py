@@ -440,9 +440,10 @@ class PackedActorModel(ActorModel, BatchableModel):
 
     def packed_apply_permutation(self, state, new_to_old, old_to_new):
         """The symmetry group action on a packed system state: gather
-        actor-indexed arrays by ``new_to_old``, rewrite embedded ids via the
-        codec hooks, and re-canonicalize the envelope table (device analog
-        of the host ``ActorModelState._permuted``)."""
+        actor-indexed arrays by ``new_to_old`` and rewrite embedded ids via
+        the codec hooks (device analog of the host
+        ``ActorModelState._permuted``). The envelope table needs no re-sort:
+        the fingerprint view digests it order-insensitively."""
         import jax
         import jax.numpy as jnp
 

@@ -43,7 +43,13 @@ from ..core.batch import BatchableModel
 from ..core.model import Expectation
 from ..core.path import Path
 from ..native import make_fingerprint_store
-from ..ops.fingerprint import FP_SCHEME, fingerprint_state, fp64_pairs, fp_to_int
+from ..ops.fingerprint import (
+    FP_SCHEME,
+    avalanche32,
+    fingerprint_state,
+    fp64_pairs,
+    fp_to_int,
+)
 from ..ops.hashset import hashset_insert, hashset_new
 from .base import Checker
 
@@ -185,7 +191,20 @@ def _make_key_fn(model, fp_fn, symmetry):
             return jnp.where(better, his, mhi), jnp.where(better, los, mlo)
 
         full = jnp.full((b,), _U32_MAX)
-        return jax.lax.fori_loop(0, n_perms, body, (full, full))
+        khi, klo = jax.lax.fori_loop(0, n_perms, body, (full, full))
+        # Re-avalanche the minima: a min over |G| uniform draws concentrates
+        # in the low 1/|G| of the key space, which would pile every home
+        # slot (top bits of hi — ops/hashset._home) into the first
+        # capacity/|G| rows. The murmur finalizer is a bijection on u32, so
+        # scrambling each word introduces no new collisions; sentinels are
+        # nudged exactly like ops/fingerprint.fingerprint_words.
+        khi = avalanche32(khi ^ jnp.uint32(0x51A7CC9E))
+        klo = avalanche32(klo ^ jnp.uint32(0xE3779B97))
+        zero = (khi == 0) & (klo == 0)
+        klo = jnp.where(zero, jnp.uint32(1), klo)
+        maxed = (khi == _U32_MAX) & (klo == _U32_MAX)
+        klo = jnp.where(maxed, jnp.uint32(_U32_MAX - 1), klo)
+        return khi, klo
 
     return orbit_keys
 
@@ -212,8 +231,9 @@ class TpuBfsChecker(Checker):
         checkpoint_min_interval_s=0.0,
         resume_from=None,
         profile_dir=None,
-        max_drain_waves=256,
+        max_drain_waves=100_000,
         drain_log_factor=8,
+        pool_factor=16,
     ):
         model = options.model
         if not isinstance(model, BatchableModel):
@@ -259,11 +279,33 @@ class TpuBfsChecker(Checker):
         # wrapped in a JAX profiler trace (viewable in TensorBoard /
         # Perfetto) and every wave gets a StepTraceAnnotation.
         self._profile_dir = profile_dir
-        # Multi-wave device drain: up to this many waves run per host round
-        # trip when frontiers stay narrow (1 = one wave per round trip).
-        # Disabled automatically when a visitor needs per-chunk callbacks.
+        # Deep device drain: the BFS runs inside one lax.while_loop with a
+        # device-resident FIFO ring of pending states (the "pool"), exiting
+        # to the host only to drain the parent-fp log, grow the table,
+        # record a property discovery, or spill a pool overflow. Each host
+        # round trip through a device tunnel costs ~0.1-1s; amortizing it
+        # over thousands of waves is what makes the device path win
+        # (SURVEY §7-5c's host-loop concern). 1 disables (wave-at-a-time);
+        # also disabled when a visitor needs per-chunk callbacks or a
+        # target count caps the run (overshoot would span whole drains).
         self._max_drain_waves = max(1, max_drain_waves)
-        self._drain_log_capacity = max(1, drain_log_factor) * self._F_max
+        if checkpoint_path is not None:
+            # A deep drain can span the whole run, which would starve the
+            # periodic checkpointer; durability caps waves-per-drain so a
+            # checkpoint opportunity arises at least every N waves. The
+            # floor of 2 keeps the deep path selected (1 means "disabled").
+            self._max_drain_waves = min(
+                self._max_drain_waves, max(2, checkpoint_every_chunks)
+            )
+        # Log must hold at least one worst-case wave (F·A fresh states) or
+        # such a wave could never be consumed device-side.
+        self._drain_log_capacity = max(
+            max(1, drain_log_factor) * self._F_max, self._F_max * self._A
+        )
+        # Pool ring capacity (power of two, ≥ one worst-case wave output).
+        self._pool_capacity = _pow2ceil(
+            max(max(1, pool_factor) * self._F_max, self._F_max * self._A)
+        )
 
         self._state_count = 0
         self._unique_count = 0
@@ -293,7 +335,10 @@ class TpuBfsChecker(Checker):
         self._symmetry_enabled = options._symmetry is not None
         self._key_fn = _make_key_fn(model, self._fp_fn, options._symmetry)
         self._jit_wave = jax.jit(self._wave)
-        self._jit_drain = jax.jit(self._drain)
+        self._jit_drain = jax.jit(self._deep_drain)
+        self._jit_pool_zero = jax.jit(self._pool_zero, static_argnums=(0,))
+        self._jit_pool_push = jax.jit(self._pool_push)
+        self._jit_pool_export = jax.jit(self._pool_export)
         self._jit_init = jax.jit(self._init_wave)
         self._jit_take = jax.jit(self._take, static_argnums=(2,))
         self._jit_finish = jax.jit(self._finish, static_argnums=(2,))
@@ -459,25 +504,107 @@ class TpuBfsChecker(Checker):
         )
         return out
 
-    def _drain(
-        self, table, states, hi, lo, ebits, depth, mask, undiscovered, budget, depth_cap
-    ):
-        """Runs consecutive BFS waves entirely on device while each wave's
-        result is *consumable* without host help: the fresh frontier fits in
-        ``F_max`` lanes, the visited set has insert budget for another full
-        wave, the device log buffer has room, no undiscovered property hit,
-        and no hash overflow. This amortizes the host↔device round trip
-        (stats pull + chunk re-queue) over up to ``max_drain_waves`` waves —
-        the round trip dominates wall clock on narrow-frontier models once
-        expansion itself is fast (SURVEY §7-5c's host-loop concern).
+    def _pool_zero(self, capacity):
+        """An empty device frontier pool (FIFO ring of pending states)."""
+        PC = capacity
+        init = self._model.packed_init_states()
+        z = jnp.zeros((PC,), jnp.uint32)
+        return {
+            "states": jax.tree_util.tree_map(
+                lambda x: jnp.zeros((PC,) + x.shape[1:], x.dtype), init
+            ),
+            "hi": z,
+            "lo": z,
+            "ebits": z,
+            "depth": jnp.zeros((PC,), jnp.int32),
+        }
+
+    def _pool_push(self, pool, head, count, chunk):
+        """Appends a host chunk's masked lanes at the ring tail."""
+        PC = self._pool_capacity
+        mask = chunk["mask"]
+        pos = jnp.cumsum(mask.astype(jnp.int32)) - 1
+        dest = jnp.where(mask, (head + count + pos) & (PC - 1), PC)
+
+        def scat(dst, src):
+            return dst.at[dest].set(src, mode="drop")
+
+        pool = {
+            "states": jax.tree_util.tree_map(
+                scat, pool["states"], chunk["states"]
+            ),
+            "hi": scat(pool["hi"], chunk["hi"]),
+            "lo": scat(pool["lo"], chunk["lo"]),
+            "ebits": scat(pool["ebits"], chunk["ebits"]),
+            "depth": scat(pool["depth"], chunk["depth"]),
+        }
+        return pool, count + mask.sum(dtype=jnp.int32)
+
+    def _pool_take(self, pool, head, count):
+        """Dequeues up to ``F_max`` lanes from the ring head as a frontier."""
+        PC, F = self._pool_capacity, self._F_max
+        lanes = jnp.arange(F, dtype=jnp.int32)
+        take_n = jnp.minimum(count, F)
+        idx = (head + lanes) & (PC - 1)
+        frontier = {
+            "states": jax.tree_util.tree_map(
+                lambda x: x[idx], pool["states"]
+            ),
+            "hi": pool["hi"][idx],
+            "lo": pool["lo"][idx],
+            "ebits": pool["ebits"][idx],
+            "depth": pool["depth"][idx],
+            "mask": lanes < take_n,
+        }
+        return frontier, (head + take_n) & (PC - 1), count - take_n
+
+    def _pool_export(self, pool, head, count):
+        """The ring contents in FIFO order (for checkpointing), padded to
+        the full pool width with the valid-lane mask attached."""
+        PC = self._pool_capacity
+        lanes = jnp.arange(PC, dtype=jnp.int32)
+        idx = (head + lanes) & (PC - 1)
+        return {
+            "states": jax.tree_util.tree_map(
+                lambda x: x[idx], pool["states"]
+            ),
+            "hi": pool["hi"][idx],
+            "lo": pool["lo"][idx],
+            "ebits": pool["ebits"][idx],
+            "depth": pool["depth"][idx],
+            "mask": lanes < count,
+        }
+
+    def _grow_pool(self, pool, head, count):
+        """Doubles the ring, preserving FIFO order (export + re-push). The
+        dependent jits retrace automatically on the new shapes."""
+        exported = self._jit_pool_export(pool, head, count)
+        self._pool_capacity *= 2
+        pool = self._jit_pool_zero(self._pool_capacity)
+        pool, count = self._jit_pool_push(
+            pool, jnp.int32(0), jnp.int32(0), exported
+        )
+        return pool, jnp.int32(0), count
+
+    def _deep_drain(self, table, pool, head, count, undiscovered, budget, depth_cap):
+        """Runs the BFS inside one device ``while_loop``: each iteration
+        pushes the previous wave's fresh states into the FIFO ring, dequeues
+        the next ``F_max`` lanes, and expands them. The loop exits to the
+        host only when a wave is *unconsumable* device-side: the parent-fp
+        log is full, the visited set needs growing, an undiscovered property
+        hit, the ring would overflow, or a hash probe overflowed. Host round
+        trips (the dominant cost through a device tunnel, and still the
+        per-wave floor on locally-attached chips) are thus amortized over
+        entire BFS phases instead of paid per wave (SURVEY §7-5c).
 
         Returns the final (unconsumed) wave output, the frontier that
-        produced it (for overflow retry), accumulated totals for the
-        consumed waves, and their (child, parent[, key]) log entries.
+        produced it (for overflow retry), the ring, accumulated totals for
+        the consumed waves, and their (child, parent[, key]) log entries.
         """
         F, A = self._F_max, self._A
         B = F * A
         L = self._drain_log_capacity
+        PC = self._pool_capacity
         P = len(self._properties)
 
         def wave_of(tbl, fr):
@@ -492,14 +619,7 @@ class TpuBfsChecker(Checker):
                 depth_cap,
             )
 
-        frontier0 = {
-            "states": states,
-            "hi": hi,
-            "lo": lo,
-            "ebits": ebits,
-            "depth": depth,
-            "mask": mask,
-        }
+        frontier0, head, count = self._pool_take(pool, head, count)
         out0 = wave_of(table, frontier0)
         zl = jnp.zeros((L,), jnp.uint32)
         log0 = {
@@ -511,6 +631,9 @@ class TpuBfsChecker(Checker):
         if self._symmetry_enabled:
             log0.update(key_hi=zl, key_lo=zl)
         carry = {
+            "pool": pool,
+            "head": head,
+            "count": count,
             "frontier": frontier0,
             "out": out0,
             "log": log0,
@@ -525,55 +648,70 @@ class TpuBfsChecker(Checker):
         def cond(c):
             o = c["out"]
             n_new = o["n_new"]
-            ok = (n_new > 0) & (n_new <= F)
+            ok = (n_new > 0) | (c["count"] > 0)
             ok &= o["overflow"] == 0
             if P:
                 ok &= ~(o["prop_hit"] & undiscovered).any()
             ok &= c["log_n"] + n_new <= L
+            ok &= c["count"] + n_new <= PC
             # Insert budget must survive consuming this wave plus another
             # full worst-case wave (B candidates).
             ok &= c["budget"] - n_new >= B
             ok &= c["waves"] < self._max_drain_waves
+            # The generated counter is device int32 (no x64); exit to the
+            # host (which accumulates in a Python int) long before a
+            # billion-generated drain could wrap it.
+            ok &= c["generated"] < jnp.int32(1 << 30)
             return ok
 
         def body(c):
             o = c["out"]
             n_new = o["n_new"]
             new = o["new"]
-            lanes = jnp.arange(F, dtype=jnp.int32)
+            lanes = jnp.arange(B, dtype=jnp.int32)
             valid = lanes < n_new
             slot = jnp.where(valid, c["log_n"] + lanes, L)
             log = dict(c["log"])
             log["child_hi"] = log["child_hi"].at[slot].set(
-                new["hi"][:F], mode="drop"
+                new["hi"], mode="drop"
             )
             log["child_lo"] = log["child_lo"].at[slot].set(
-                new["lo"][:F], mode="drop"
+                new["lo"], mode="drop"
             )
             log["parent_hi"] = log["parent_hi"].at[slot].set(
-                o["parent_hi"][:F], mode="drop"
+                o["parent_hi"], mode="drop"
             )
             log["parent_lo"] = log["parent_lo"].at[slot].set(
-                o["parent_lo"][:F], mode="drop"
+                o["parent_lo"], mode="drop"
             )
             if self._symmetry_enabled:
                 log["key_hi"] = log["key_hi"].at[slot].set(
-                    o["key_hi"][:F], mode="drop"
+                    o["key_hi"], mode="drop"
                 )
                 log["key_lo"] = log["key_lo"].at[slot].set(
-                    o["key_lo"][:F], mode="drop"
+                    o["key_lo"], mode="drop"
                 )
-            frontier = {
-                "states": jax.tree_util.tree_map(
-                    lambda x: x[:F], new["states"]
-                ),
-                "hi": new["hi"][:F],
-                "lo": new["lo"][:F],
-                "ebits": new["ebits"][:F],
-                "depth": new["depth"][:F],
-                "mask": valid,
-            }
+            # Push the fresh (compacted-prefix) lanes at the ring tail, then
+            # dequeue the next frontier from the head — strict FIFO keeps
+            # exact BFS order, so parent pointers stay shortest-path.
+            pool, count = self._pool_push(
+                c["pool"],
+                c["head"],
+                c["count"],
+                {
+                    "states": new["states"],
+                    "hi": new["hi"],
+                    "lo": new["lo"],
+                    "ebits": new["ebits"],
+                    "depth": new["depth"],
+                    "mask": valid,
+                },
+            )
+            frontier, head, count = self._pool_take(pool, c["head"], count)
             return {
+                "pool": pool,
+                "head": head,
+                "count": count,
                 "frontier": frontier,
                 "out": wave_of(o["table"], frontier),
                 "log": log,
@@ -586,7 +724,9 @@ class TpuBfsChecker(Checker):
             }
 
         res = jax.lax.while_loop(cond, body, carry)
-        # One consolidated transfer for the consumed-wave bookkeeping.
+        # One consolidated transfer for the consumed-wave bookkeeping, and
+        # the log columns stacked into a single array so the host pulls the
+        # whole drain's parent-fp stream in one more transfer.
         res["drain_stats"] = jnp.stack(
             [
                 res["log_n"],
@@ -594,8 +734,13 @@ class TpuBfsChecker(Checker):
                 res["consumed_unique"],
                 res["max_depth"],
                 res["waves"],
+                res["count"],
             ]
         )
+        cols = ["child_hi", "child_lo", "parent_hi", "parent_lo"]
+        if self._symmetry_enabled:
+            cols += ["key_hi", "key_lo"]
+        res["log_pack"] = jnp.stack([res["log"][c] for c in cols])
         return res
 
     def _take(self, arrs, start, size):
@@ -663,13 +808,75 @@ class TpuBfsChecker(Checker):
         # Wall-clock burned before the first wave returns — dominated by XLA
         # compilation; benchmarks subtract it to report steady-state rate.
         self.warmup_seconds: Optional[float] = None
-        props = self._properties
         if self._resume_from is not None:
             table, queue = self._restore(self._resume_from)
         else:
             table, queue = self._seed()
         depth_cap = jnp.int32(self._depth_cap)
+        # Deep drain is off when a visitor needs per-chunk callbacks or a
+        # target caps the run (overshoot would span whole drains instead of
+        # single waves).
+        if (
+            self._max_drain_waves > 1
+            and self._visitor is None
+            and self._target_state_count is None
+        ):
+            self._explore_deep(table, queue, depth_cap, t_start)
+        else:
+            self._explore_waves(table, queue, depth_cap, t_start)
 
+    def _consume_wave(self, table, wave, chunk, queue, depth_cap):
+        """Applies one wave output host-side (counters, discoveries, log,
+        requeue), retrying the producing frontier after table growth until
+        no probe overflows. Returns the updated table."""
+        props = self._properties
+        B = chunk["hi"].shape[0] * self._A
+        attempt = 0
+        while True:
+            if wave is None:
+                wave = self._jit_wave(
+                    table,
+                    chunk["states"],
+                    chunk["hi"],
+                    chunk["lo"],
+                    chunk["ebits"],
+                    chunk["depth"],
+                    chunk["mask"],
+                    depth_cap,
+                )
+            table = wave["table"]
+            # Single host transfer per wave: [generated, n_new, overflow,
+            # max_depth, any_prop_hit?]; per-property fingerprints are
+            # pulled only on a hit.
+            stats = np.asarray(wave["stats"])
+            if attempt == 0:
+                self._state_count += int(stats[0])
+                self._max_depth = max(self._max_depth, int(stats[3]))
+                if props and stats[4]:
+                    hit = np.asarray(wave["prop_hit"])
+                    phi = np.asarray(wave["prop_hi"])
+                    plo = np.asarray(wave["prop_lo"])
+                    for i, p in enumerate(props):
+                        if hit[i] and p.name not in self._discoveries_fp:
+                            self._discoveries_fp[p.name] = fp_to_int(
+                                phi[i], plo[i]
+                            )
+                if self._visitor is not None:
+                    self._visit_chunk(chunk)
+            n_new = int(stats[1])
+            self._unique_count += n_new
+            if n_new:
+                self._log_wave(wave, n_new)
+                self._enqueue(queue, wave, n_new, B)
+            if not int(stats[2]):
+                return table
+            table = self._grow_table(table, self._capacity * 2)
+            attempt += 1
+            wave = None
+
+    def _explore_waves(self, table, queue, depth_cap, t_start):
+        """Wave-at-a-time host loop (visitor callbacks / target counts)."""
+        props = self._properties
         chunks = 0
         last_checkpoint = time.perf_counter()
         while queue:
@@ -689,126 +896,150 @@ class TpuBfsChecker(Checker):
                 and (time.perf_counter() - last_checkpoint)
                 >= self._checkpoint_min_interval
             ):
-                self.save_checkpoint(self._checkpoint_path, queue)
+                self.save_checkpoint(self._checkpoint_path, list(queue))
                 last_checkpoint = time.perf_counter()
             chunks += 1
             chunk = queue.popleft()
-            F = chunk["hi"].shape[0]
-            B = F * self._A
+            B = chunk["hi"].shape[0] * self._A
             if (self._unique_count + B) > _MAX_LOAD * self._capacity:
                 table = self._grow_table(
                     table, _pow2ceil(int((self._unique_count + B) / _MAX_LOAD))
                 )
+            with jax.profiler.StepTraceAnnotation(
+                "tpu_bfs.wave", step_num=chunks
+            ):
+                table = self._consume_wave(table, None, chunk, queue, depth_cap)
+            if self.warmup_seconds is None:
+                self.warmup_seconds = time.perf_counter() - t_start
 
-            # Multi-wave device drain (off when a visitor needs per-chunk
-            # callbacks, or when a target caps the count — overshoot would
-            # span whole drains instead of single waves).
-            use_drain = (
-                self._max_drain_waves > 1
-                and self._visitor is None
-                and self._target_state_count is None
+    def _explore_deep(self, table, queue, depth_cap, t_start):
+        """Deep-drain host loop: keeps the pending frontier in the device
+        ring and re-enters ``_deep_drain`` until the space is exhausted,
+        paying host round trips only at drain exits."""
+        props = self._properties
+        if not props:
+            return
+        B = self._F_max * self._A
+        pool = self._jit_pool_zero(self._pool_capacity)
+        head = jnp.int32(0)
+        count = jnp.int32(0)
+        pool_count = 0  # host view; exact after each drain, bounded after pushes
+        drains = 0
+        last_checkpoint = time.perf_counter()
+        compiled = False
+        while True:
+            if len(self._discoveries_fp) == len(props):
+                break
+            # The host queue must FULLY drain into the ring before the next
+            # drain: leftover spilled chunks are older than anything the
+            # drain will push, so leaving them queued would let newer states
+            # jump ahead and break exact BFS order (depth labels and
+            # shortest-path parents). Grow the ring when they don't fit —
+            # exact BFS inherently holds the whole pending frontier, just
+            # like the reference's host queue. Push dispatches stay
+            # device-side (no blocking transfer).
+            while queue:
+                if pool_count + self._F_max > self._pool_capacity:
+                    # The host bound overcounts (F_max per push); refresh it
+                    # from the device before paying for a ring doubling.
+                    pool_count = int(np.asarray(count))
+                    if pool_count + self._F_max > self._pool_capacity:
+                        pool, head, count = self._grow_pool(pool, head, count)
+                chunk = queue.popleft()
+                pool, count = self._jit_pool_push(pool, head, count, chunk)
+                pool_count += self._F_max
+            if pool_count == 0:
+                break
+            # Every drain exit is a checkpoint opportunity (waves-per-drain
+            # is capped when a checkpoint path is set); the time floor
+            # throttles the full parent-map export + pickle.
+            if (
+                self._checkpoint_path is not None
+                and drains
+                and (time.perf_counter() - last_checkpoint)
+                >= self._checkpoint_min_interval
+            ):
+                # The ring is the sole pending-frontier store here: the
+                # push loop above always fully drains the host queue.
+                assert not queue
+                self.save_checkpoint(
+                    self._checkpoint_path,
+                    self._export_pool_chunks(pool, head, count),
+                )
+                last_checkpoint = time.perf_counter()
+            drains += 1
+            if (self._unique_count + B) > _MAX_LOAD * self._capacity:
+                table = self._grow_table(
+                    table, _pow2ceil(int((self._unique_count + B) / _MAX_LOAD))
+                )
+            undiscovered = np.array(
+                [p.name not in self._discoveries_fp for p in props]
             )
-            wave = None
-            if use_drain:
-                undiscovered = np.array(
-                    [p.name not in self._discoveries_fp for p in props]
-                )
-                budget = jnp.int32(
-                    int(_MAX_LOAD * self._capacity) - self._unique_count
-                )
-                with jax.profiler.StepTraceAnnotation(
-                    "tpu_bfs.drain", step_num=chunks
-                ):
-                    res = self._jit_drain(
-                        table,
-                        chunk["states"],
-                        chunk["hi"],
-                        chunk["lo"],
-                        chunk["ebits"],
-                        chunk["depth"],
-                        chunk["mask"],
-                        jnp.asarray(undiscovered),
-                        budget,
-                        depth_cap,
-                    )
-                    dstats = np.asarray(res["drain_stats"])
+            budget = jnp.int32(
+                int(_MAX_LOAD * self._capacity) - self._unique_count
+            )
+            if not compiled:
+                # Compile ahead of the first real call so warmup measures
+                # pure compilation: a single deep drain can run the whole
+                # exploration, so "time until the first result returned"
+                # (the wave path's proxy) would fold exploration into
+                # warmup and corrupt steady-state rates.
+                self._jit_drain.lower(
+                    table,
+                    pool,
+                    head,
+                    count,
+                    jnp.asarray(undiscovered),
+                    budget,
+                    depth_cap,
+                ).compile()
+                compiled = True
                 if self.warmup_seconds is None:
                     self.warmup_seconds = time.perf_counter() - t_start
-                log_n = int(dstats[0])
-                self._state_count += int(dstats[1])
-                self._unique_count += int(dstats[2])
-                self._max_depth = max(self._max_depth, int(dstats[3]))
-                if log_n:
-                    log = res["log"]
-                    self._wave_log.append(
-                        (
-                            fp64_pairs(
-                                log["child_hi"][:log_n], log["child_lo"][:log_n]
-                            ),
-                            fp64_pairs(
-                                log["parent_hi"][:log_n],
-                                log["parent_lo"][:log_n],
-                            ),
-                        )
-                    )
-                    if self._symmetry_enabled:
-                        self._key_log.append(
-                            fp64_pairs(
-                                log["key_hi"][:log_n], log["key_lo"][:log_n]
-                            )
-                        )
-                    # Consumed frontiers never left the device: re-queue
-                    # nothing — they were fully expanded in the drain.
-                wave = res["out"]
-                chunk = res["frontier"]  # the pending wave's input, for retry
+            with jax.profiler.StepTraceAnnotation(
+                "tpu_bfs.drain", step_num=drains
+            ):
+                res = self._jit_drain(
+                    table,
+                    pool,
+                    head,
+                    count,
+                    jnp.asarray(undiscovered),
+                    budget,
+                    depth_cap,
+                )
+                dstats = np.asarray(res["drain_stats"])
+            log_n = int(dstats[0])
+            self._state_count += int(dstats[1])
+            self._unique_count += int(dstats[2])
+            self._max_depth = max(self._max_depth, int(dstats[3]))
+            pool, head, count = res["pool"], res["head"], res["count"]
+            pool_count = int(dstats[5])
+            if log_n:
+                # The whole drain's parent-fp stream in one transfer.
+                pack = np.asarray(res["log_pack"][:, :log_n])
+                self._wave_log.append(
+                    (fp64_pairs(pack[0], pack[1]), fp64_pairs(pack[2], pack[3]))
+                )
+                if self._symmetry_enabled:
+                    self._key_log.append(fp64_pairs(pack[4], pack[5]))
+            # Consume the final (unconsumable device-side) wave the slow
+            # way; its fresh chunks spill into the host queue and are fed
+            # back into the ring on the next loop pass.
+            table = self._consume_wave(
+                table, res["out"], res["frontier"], queue, depth_cap
+            )
 
-            attempt = 0
-            while True:
-                if wave is None:
-                    with jax.profiler.StepTraceAnnotation(
-                        "tpu_bfs.wave", step_num=chunks
-                    ):
-                        wave = self._jit_wave(
-                            table,
-                            chunk["states"],
-                            chunk["hi"],
-                            chunk["lo"],
-                            chunk["ebits"],
-                            chunk["depth"],
-                            chunk["mask"],
-                            depth_cap,
-                        )
-                table = wave["table"]
-                # Single host transfer per wave: [generated, n_new,
-                # overflow, max_depth, any_prop_hit?]; per-property
-                # fingerprints are pulled only on a hit.
-                stats = np.asarray(wave["stats"])
-                if self.warmup_seconds is None:
-                    self.warmup_seconds = time.perf_counter() - t_start
-                if attempt == 0:
-                    self._state_count += int(stats[0])
-                    self._max_depth = max(self._max_depth, int(stats[3]))
-                    if props and stats[4]:
-                        hit = np.asarray(wave["prop_hit"])
-                        phi = np.asarray(wave["prop_hi"])
-                        plo = np.asarray(wave["prop_lo"])
-                        for i, p in enumerate(props):
-                            if hit[i] and p.name not in self._discoveries_fp:
-                                self._discoveries_fp[p.name] = fp_to_int(
-                                    phi[i], plo[i]
-                                )
-                    if self._visitor is not None:
-                        self._visit_chunk(chunk)
-                n_new = int(stats[1])
-                self._unique_count += n_new
-                if n_new:
-                    self._log_wave(wave, n_new)
-                    self._enqueue(queue, wave, n_new, B)
-                if not int(stats[2]):
-                    break
-                table = self._grow_table(table, self._capacity * 2)
-                attempt += 1
-                wave = None
+    def _export_pool_chunks(self, pool, head, count):
+        """The ring contents as F_max-wide host chunks (for checkpoints)."""
+        exported = self._jit_pool_export(pool, head, count)
+        n = int(np.asarray(count))
+        chunks = []
+        for start in range(0, n, self._F_max):
+            chunks.append(
+                self._jit_take(exported, jnp.int32(start), self._F_max)
+            )
+        return chunks
 
     def _seed(self):
         """Inserts + enqueues the initial states; returns (table, queue)."""

@@ -135,8 +135,10 @@ class BatchableModel:
     ) -> PackedState:
         """Traceable group action: applies one permutation row to a packed
         state (gather index-keyed arrays by ``new_to_old``; rewrite embedded
-        actor ids through ``old_to_new``; re-canonicalize order-insensitive
-        components)."""
+        actor ids through ``old_to_new``). Order-insensitive components need
+        NO re-canonicalization: the fingerprint view hashes them with a
+        commutative multiset digest (``ops.fingerprint.multiset_digest``),
+        so slot order never reaches the key."""
         raise NotImplementedError
 
     # -- host interop ------------------------------------------------------

@@ -1,0 +1,76 @@
+"""Micro-bench: XLA scatter-claim insert vs the Pallas tile-sweep kernel
+on the current default device. Usage::
+
+    python -m stateright_tpu.ops.bench_hashset [log2_capacity] [batch]
+
+Feeds both paths identical sorted batches at the checkers' target load
+factor and prints keys/sec for each. Decides whether the TPU checkers
+should flip ``hashset_impl`` to Pallas (see ``checker/tpu.py``).
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def main():
+    log2_cap = int(sys.argv[1]) if len(sys.argv) > 1 else 20
+    batch = int(sys.argv[2]) if len(sys.argv) > 2 else 1 << 15
+    cap = 1 << log2_cap
+    rounds = max(1, int(cap * 0.5) // batch)  # fill to ~50% load
+
+    from .hashset import hashset_insert, hashset_new
+    from .pallas_hashset import pallas_hashset_insert
+
+    dev = jax.devices()[0]
+    interpret = dev.platform != "tpu"
+    print(f"device={dev.platform} cap=2^{log2_cap} batch={batch} "
+          f"rounds={rounds} interpret={interpret}", file=sys.stderr)
+
+    rng = np.random.default_rng(0)
+
+    def batches():
+        for _ in range(rounds):
+            hi = rng.integers(0, 1 << 32, batch, np.uint64).astype(np.uint32)
+            lo = rng.integers(1, 1 << 32, batch, np.uint64).astype(np.uint32)
+            order = np.lexsort((lo, hi))
+            yield jnp.asarray(hi[order]), jnp.asarray(lo[order])
+
+    ones = jnp.ones((batch,), bool)
+
+    for name, fn in (
+        ("xla", lambda t, h, l: hashset_insert(t, h, l, ones)),
+        (
+            "pallas",
+            lambda t, h, l: pallas_hashset_insert(
+                t, h, l, ones, interpret=interpret
+            ),
+        ),
+    ):
+        data = list(batches())
+        table = hashset_new(cap)
+        # Warm up compile on the first batch shape.
+        out = fn(table, *data[0])
+        jax.block_until_ready(out[0])
+        table = hashset_new(cap)
+        t0 = time.perf_counter()
+        inserted = 0
+        for h, l in data:
+            table, fresh, _found, pend = fn(table, h, l)
+            inserted += batch
+        jax.block_until_ready(table)
+        dt = time.perf_counter() - t0
+        print(
+            f"{name}: {inserted} keys in {dt:.3f}s = {inserted/dt:,.0f}/s "
+            f"(pending={int(np.asarray(pend).sum())})"
+        )
+
+
+if __name__ == "__main__":
+    main()

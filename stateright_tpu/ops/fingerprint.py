@@ -30,6 +30,7 @@ __all__ = [
     "fingerprint_state",
     "fp_to_int",
     "multiset_digest",
+    "avalanche32",
 ]
 
 _C1 = 0xCC9E2D51
@@ -57,6 +58,14 @@ def _fmix(h: jax.Array) -> jax.Array:
     h = h ^ (h >> 13)
     h = h * jnp.uint32(0xC2B2AE35)
     return h ^ (h >> 16)
+
+
+def avalanche32(h: jax.Array) -> jax.Array:
+    """Murmur3's fmix32: an invertible avalanche on uint32 lanes. Public
+    because the checkers re-avalanche symmetry orbit-minimum keys with it
+    (``checker/tpu._make_key_fn``) — any change here changes the visited-key
+    space and MUST bump ``FP_SCHEME``."""
+    return _fmix(h)
 
 
 def _leaf_words(leaf: jax.Array) -> jax.Array:
@@ -168,8 +177,9 @@ def fp64_pairs(hi, lo):
     ).astype(np.uint64)
 
 
-# Identifies the fingerprint definition (word layout + mixing). Checkpoints
-# record it: visited-set keys and parent-store fps from a different scheme
-# cannot be mixed into a resumed run. Bump on ANY change to the functions
-# above or to a model's fingerprint view encoding.
-FP_SCHEME = "mm3x2/msdigest-v2"
+# Identifies the fingerprint definition (word layout + mixing, including the
+# orbit-key avalanche in checker/tpu._make_key_fn). Checkpoints record it:
+# visited-set keys and parent-store fps from a different scheme cannot be
+# mixed into a resumed run. Bump on ANY change to the functions above, the
+# orbit-key scramble, or a model's fingerprint view encoding.
+FP_SCHEME = "mm3x2/msdigest-v3"

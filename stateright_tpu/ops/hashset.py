@@ -2,12 +2,21 @@
 
 This replaces the reference's concurrent visited set (``DashMap`` keyed by
 fingerprint, ``/root/reference/src/checker/bfs.rs:28-29``) with an XLA-native
-structure: a ``(capacity, 2)`` uint32 table of (hi, lo) fingerprint pairs,
-linear probing, and batched insert where competing lanes claim empty slots
-via a row-window scatter (duplicate scatter indices resolve to exactly one
-winning row — XLA applies each update as an atomic window) and re-read to
-learn who won. Lanes that lose a claim race keep probing, exactly like a
-CAS-loop insert on CPU.
+structure: a ``(capacity + MAX_PROBES, 2)`` uint32 table of (hi, lo)
+fingerprint pairs, linear probing, and batched insert where competing lanes
+claim empty slots via a row-window scatter (duplicate scatter indices
+resolve to exactly one winning row — XLA applies each update as an atomic
+window) and re-read to learn who won. Lanes that lose a claim race keep
+probing, exactly like a CAS-loop insert on CPU.
+
+The home slot is *monotone in the key*: ``home = top log2(capacity) bits of
+hi``, a multiply-shift hash by a power of two. The checkers always insert
+keys in sorted order (the wave dedup sorts them), so consecutive lanes probe
+consecutive table regions — turning the per-probe gather/scatter into a
+nearly-sequential HBM access pattern instead of random walks over the whole
+table. Probes run ``home, home+1, ...`` without wraparound into a
+``MAX_PROBES``-row apron past the end (no modulo in the hot loop, and a
+future tiled/Pallas kernel never needs a circular window).
 
 Keys must be wave-unique before insertion (dedup by sort upstream) so a
 "slot holds my key" observation implies *this lane* inserted or the key was
@@ -32,17 +41,20 @@ __all__ = ["hashset_new", "hashset_insert", "hashset_contains", "MAX_PROBES"]
 # linear-probe clusters practically never approach this.
 MAX_PROBES = 128
 
-_SCRAMBLE = 0x9E3779B9
-
 
 def hashset_new(capacity: int) -> jax.Array:
-    """An empty table. ``capacity`` must be a power of two."""
+    """An empty table. ``capacity`` must be a power of two; the allocation
+    carries a ``MAX_PROBES``-row apron so probes never wrap."""
     assert capacity & (capacity - 1) == 0, "capacity must be a power of two"
-    return jnp.zeros((capacity, 2), dtype=jnp.uint32)
+    return jnp.zeros((capacity + MAX_PROBES, 2), dtype=jnp.uint32)
 
 
-def _probe_base(key_hi: jax.Array, key_lo: jax.Array) -> jax.Array:
-    return key_lo ^ (key_hi * jnp.uint32(_SCRAMBLE))
+def _home(key_hi: jax.Array, capacity: int) -> jax.Array:
+    """Monotone home slot: the top ``log2(capacity)`` bits of ``hi``."""
+    k = capacity.bit_length() - 1
+    if k == 0:
+        return jnp.zeros_like(key_hi, dtype=jnp.int32)
+    return (key_hi >> jnp.uint32(32 - k)).astype(jnp.int32)
 
 
 def hashset_insert(
@@ -59,9 +71,8 @@ def hashset_insert(
     - ``overflow``: probe cap exhausted (host must grow and retry).
     Inactive lanes report none of the three.
     """
-    capacity = table.shape[0]
-    mask = jnp.uint32(capacity - 1)
-    base = _probe_base(key_hi, key_lo)
+    capacity = table.shape[0] - MAX_PROBES
+    base = _home(key_hi, capacity)
 
     def cond(carry):
         _table, r, pending, _fresh, _found = carry
@@ -69,7 +80,7 @@ def hashset_insert(
 
     def body(carry):
         table, r, pending, fresh, found = carry
-        idx = ((base + jnp.uint32(r)) & mask).astype(jnp.int32)
+        idx = base + r
         row = table[idx]
         cur_hi, cur_lo = row[:, 0], row[:, 1]
         empty = (cur_hi == 0) & (cur_lo == 0)
@@ -77,8 +88,9 @@ def hashset_insert(
         found = found | (pending & match)
         attempt = pending & empty & ~match
         # Claim: one full-row update wins per index; losers observe the
-        # winner's key on re-read and continue probing.
-        scatter_idx = jnp.where(attempt, idx, capacity)
+        # winner's key on re-read and continue probing. (OOB sentinel must
+        # lie past the apron — ``capacity`` itself is a valid apron slot.)
+        scatter_idx = jnp.where(attempt, idx, capacity + MAX_PROBES)
         update = jnp.stack([key_hi, key_lo], axis=-1)
         table = table.at[scatter_idx].set(update, mode="drop")
         row2 = table[idx]
@@ -99,9 +111,8 @@ def hashset_contains(
     table: jax.Array, key_hi: jax.Array, key_lo: jax.Array
 ) -> jax.Array:
     """Batched membership probe (no mutation)."""
-    capacity = table.shape[0]
-    mask = jnp.uint32(capacity - 1)
-    base = _probe_base(key_hi, key_lo)
+    capacity = table.shape[0] - MAX_PROBES
+    base = _home(key_hi, capacity)
     n = key_hi.shape[0]
 
     def cond(carry):
@@ -110,7 +121,7 @@ def hashset_contains(
 
     def body(carry):
         r, pending, found = carry
-        idx = ((base + jnp.uint32(r)) & mask).astype(jnp.int32)
+        idx = base + r
         row = table[idx]
         empty = (row[:, 0] == 0) & (row[:, 1] == 0)
         match = (row[:, 0] == key_hi) & (row[:, 1] == key_lo)

@@ -1,0 +1,229 @@
+"""Pallas TPU kernel for the visited-set insert (tile-sweep open addressing).
+
+The XLA path (``ops/hashset.py``) probes with per-round full-table gathers:
+every probe round issues a B-lane random gather + scatter + re-read over the
+whole HBM-resident table. This kernel exploits two structural facts the
+checkers guarantee:
+
+1. keys arrive **sorted** (the wave dedup sorts them), and
+2. the home slot is **monotone in the key** (top bits of ``hi`` —
+   ``ops/hashset._home``),
+
+so the batch touches the table in a single left-to-right sweep. The kernel
+grids over fixed-size table *tiles*; per tile it DMAs one window (tile +
+``MAX_PROBES`` apron) HBM→VMEM, resolves every key homed in the tile against
+VMEM (probe window compare + first-empty claim, sequentially per key — which
+is exact CAS-free open addressing, since within one batch the keys are
+processed in order), and DMAs the window back before the next tile starts.
+Tiles no key homes into are skipped entirely — untouched rows never cross
+HBM. Per-tile scalar ranges arrive via ``PrefetchScalarGridSpec`` from a
+host-side ``searchsorted`` over the (monotone) homes.
+
+Semantics match ``hashset_insert`` exactly (same contract, same claim/fresh/
+found/pending outcomes) — property-tested against it in
+``tests/test_pallas_hashset.py`` — EXCEPT that duplicate in-batch keys are
+also handled (second occurrence reports ``found``), which is a superset of
+the wave-unique contract.
+
+Reference analog: the ``DashMap`` visited set at
+``/root/reference/src/checker/bfs.rs:28-29``; SURVEY §7-5c calls for exactly
+this "insert-heavy open-addressing in Pallas" design.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .hashset import MAX_PROBES
+
+__all__ = ["pallas_hashset_insert", "TILE_ROWS"]
+
+# Table rows per grid step. 2048 rows × (2×4B) = 16KB window DMA (+ apron).
+TILE_ROWS = 2048
+# Keys resolved per inner chunk (bounds the per-chunk VMEM staging).
+_KC = 256
+
+
+def _insert_kernel(
+    starts_ref,  # scalar-prefetch: (n_tiles + 1,) int32 key-range bounds
+    cap_bits_ref,  # scalar-prefetch: (1,) int32 log2(capacity)
+    key_hi_ref,  # VMEM (Bp,) uint32, sorted
+    key_lo_ref,  # VMEM (Bp,) uint32
+    active_ref,  # VMEM (Bp,) uint32 0/1
+    table_ref,  # ANY/HBM (capacity + MAX_PROBES, 2) uint32, aliased output
+    out_table_ref,  # alias of table_ref
+    fresh_ref,  # VMEM (Bp,) uint32 out
+    found_ref,  # VMEM (Bp,) uint32 out
+    pending_ref,  # VMEM (Bp,) uint32 out
+    window,  # VMEM scratch (TILE_ROWS + MAX_PROBES, 2) uint32
+    sem_in,
+    sem_out,
+):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    t = pl.program_id(0)
+    s = starts_ref[t]
+    e = starts_ref[t + 1]
+    shift = 32 - cap_bits_ref[0]
+
+    @pl.when(t == 0)
+    def _zero_outputs():
+        # Output buffers are uninitialized; lanes no tile writes (inactive
+        # or sentinel keys) must still report all-false.
+        fresh_ref[...] = jnp.zeros_like(fresh_ref)
+        found_ref[...] = jnp.zeros_like(found_ref)
+        pending_ref[...] = jnp.zeros_like(pending_ref)
+
+    @pl.when(e > s)
+    def _process_tile():
+        base = t * TILE_ROWS
+        dma_in = pltpu.make_async_copy(
+            out_table_ref.at[pl.ds(base, TILE_ROWS + MAX_PROBES)],
+            window,
+            sem_in,
+        )
+        dma_in.start()
+        dma_in.wait()
+
+        def chunk_body(c, _):
+            k0 = s + c * _KC
+
+            def key_body(k, _):
+                i = k0 + k
+
+                @pl.when((i < e) & (active_ref[i] != 0))
+                def _one_key():
+                    kh = key_hi_ref[i]
+                    kl = key_lo_ref[i]
+                    local = (
+                        (kh >> shift.astype(jnp.uint32)).astype(jnp.int32)
+                        - base
+                    )
+                    rows_hi = window[pl.ds(local, MAX_PROBES), 0]
+                    rows_lo = window[pl.ds(local, MAX_PROBES), 1]
+                    idx = jax.lax.broadcasted_iota(
+                        jnp.int32, (MAX_PROBES, 1), 0
+                    ).reshape(MAX_PROBES)
+                    big = jnp.int32(MAX_PROBES)
+                    empty = (rows_hi == 0) & (rows_lo == 0)
+                    match = (rows_hi == kh) & (rows_lo == kl)
+                    first_empty = jnp.min(jnp.where(empty, idx, big))
+                    first_match = jnp.min(jnp.where(match, idx, big))
+                    is_found = first_match < first_empty
+                    can_claim = (first_empty < big) & ~is_found
+                    # Sequential processing makes the claim race-free: the
+                    # next key observes this write in VMEM immediately. The
+                    # claim is a masked whole-probe-window rewrite (a
+                    # vector store — Mosaic handles dynamic scalar stores
+                    # to VMEM poorly).
+                    claim = can_claim & (idx == first_empty)
+                    window[pl.ds(local, MAX_PROBES), 0] = jnp.where(
+                        claim, kh, rows_hi
+                    )
+                    window[pl.ds(local, MAX_PROBES), 1] = jnp.where(
+                        claim, kl, rows_lo
+                    )
+                    fresh_ref[i] = can_claim.astype(jnp.uint32)
+                    found_ref[i] = is_found.astype(jnp.uint32)
+                    pending_ref[i] = (~is_found & ~can_claim).astype(
+                        jnp.uint32
+                    )
+
+            jax.lax.fori_loop(0, _KC, key_body, None)
+            return 0
+
+        n_chunks = (e - s + _KC - 1) // _KC
+        jax.lax.fori_loop(0, n_chunks, chunk_body, 0)
+
+        dma_out = pltpu.make_async_copy(
+            window,
+            out_table_ref.at[pl.ds(base, TILE_ROWS + MAX_PROBES)],
+            sem_out,
+        )
+        dma_out.start()
+        dma_out.wait()
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def pallas_hashset_insert(
+    table: jax.Array,
+    key_hi: jax.Array,
+    key_lo: jax.Array,
+    active: jax.Array,
+    interpret: bool = False,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Drop-in for ``hashset_insert`` when keys are sorted ascending by
+    (hi, lo). Returns ``(table, fresh, found, pending)``."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    capacity = table.shape[0] - MAX_PROBES
+    cap_bits = capacity.bit_length() - 1
+    assert capacity == (1 << cap_bits), "capacity must be a power of two"
+    assert capacity % TILE_ROWS == 0, (
+        f"capacity must be a multiple of TILE_ROWS={TILE_ROWS}"
+    )
+    n_tiles = capacity // TILE_ROWS
+    B = key_hi.shape[0]
+
+    # Host-side (XLA) prep: homes are monotone in the sorted keys, so each
+    # tile's keys form a contiguous range found by searchsorted.
+    homes = (key_hi >> jnp.uint32(32 - cap_bits)).astype(jnp.int32)
+    # Inactive lanes must not extend ranges: sorted order puts the u32max
+    # sentinels last; they map into the final tile and are masked by
+    # ``active`` inside the kernel.
+    bounds = jnp.arange(1, n_tiles + 1, dtype=jnp.int32) * TILE_ROWS
+    starts = jnp.concatenate(
+        [
+            jnp.zeros((1,), jnp.int32),
+            jnp.searchsorted(homes, bounds).astype(jnp.int32),
+        ]
+    )
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(n_tiles,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((TILE_ROWS + MAX_PROBES, 2), jnp.uint32),
+            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA,
+        ],
+    )
+    out_table, fresh, found, pending = pl.pallas_call(
+        _insert_kernel,
+        grid_spec=grid_spec,
+        out_shape=(
+            jax.ShapeDtypeStruct(table.shape, table.dtype),
+            jax.ShapeDtypeStruct((B,), jnp.uint32),
+            jax.ShapeDtypeStruct((B,), jnp.uint32),
+            jax.ShapeDtypeStruct((B,), jnp.uint32),
+        ),
+        input_output_aliases={5: 0},  # table (arg idx incl. 2 prefetch args)
+        compiler_params=pltpu.CompilerParams(has_side_effects=True),
+        interpret=interpret,
+    )(
+        starts,
+        jnp.full((1,), cap_bits, jnp.int32),
+        key_hi,
+        key_lo,
+        active.astype(jnp.uint32),
+        table,
+    )
+    return out_table, fresh != 0, found != 0, pending != 0

@@ -50,7 +50,7 @@ from ..core.model import Expectation
 from ..core.path import Path
 from ..native import make_fingerprint_store
 from ..ops.fingerprint import fingerprint_state, fp64_pairs, fp_to_int
-from ..ops.hashset import hashset_insert, hashset_new
+from ..ops.hashset import MAX_PROBES, hashset_insert
 from .base_mesh import default_mesh
 from ..checker.base import Checker
 from ..checker.tpu import (
@@ -421,8 +421,11 @@ class ShardedTpuBfsChecker(Checker):
     def _new_table(self):
         # Allocate pre-sharded: materializing the global table on one device
         # first would OOM exactly when shards are sized near per-device HBM.
+        # Each shard carries the probe apron the hashset ops expect.
         return jax.jit(
-            lambda: jnp.zeros((self._n, self._cap_loc, 2), jnp.uint32),
+            lambda: jnp.zeros(
+                (self._n, self._cap_loc + MAX_PROBES, 2), jnp.uint32
+            ),
             out_shardings=self._shard,
         )()
 

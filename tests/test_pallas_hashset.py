@@ -1,0 +1,153 @@
+"""Pallas visited-set insert vs the XLA scatter-claim path: exact outcome
+parity (fresh/found/pending flags and final table contents-as-set) on
+randomized sorted batches, in interpret mode (CPU).
+
+The kernel requires sorted keys (the checkers' wave dedup guarantees it);
+these tests mirror that contract, including inactive sentinel lanes and
+repeat-insert batches.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from stateright_tpu.ops.hashset import MAX_PROBES, hashset_insert, hashset_new
+from stateright_tpu.ops.pallas_hashset import (
+    TILE_ROWS,
+    pallas_hashset_insert,
+)
+
+CAP = TILE_ROWS * 2  # two tiles; exercises the cross-tile margin
+
+
+def _sorted_batch(rng, n, active_frac=0.9, dup_frac=0.0, span=None):
+    hi = rng.integers(0, span or (1 << 32), size=n, dtype=np.uint64).astype(
+        np.uint32
+    )
+    lo = rng.integers(1, 1 << 32, size=n, dtype=np.uint64).astype(np.uint32)
+    if dup_frac:
+        k = max(1, int(n * dup_frac))
+        hi[:k] = hi[n // 2 : n // 2 + k]
+        lo[:k] = lo[n // 2 : n // 2 + k]
+    active = rng.random(n) < active_frac
+    hi = np.where(active, hi, 0xFFFFFFFF).astype(np.uint32)
+    lo = np.where(active, lo, 0xFFFFFFFF).astype(np.uint32)
+    order = np.lexsort((lo, hi))
+    return (
+        jnp.asarray(hi[order]),
+        jnp.asarray(lo[order]),
+        jnp.asarray(active[order]),
+    )
+
+
+def _table_keys(table):
+    t = np.asarray(table)
+    live = (t[:, 0] != 0) | (t[:, 1] != 0)
+    return set(zip(t[live, 0].tolist(), t[live, 1].tolist()))
+
+
+def _dedup_first(hi, lo, active):
+    """Wave-unique mask: first active occurrence of each (hi, lo)."""
+    hi, lo, active = (np.asarray(x) for x in (hi, lo, active))
+    seen = set()
+    out = np.zeros_like(active)
+    for i in range(len(hi)):
+        if active[i] and (int(hi[i]), int(lo[i])) not in seen:
+            seen.add((int(hi[i]), int(lo[i])))
+            out[i] = True
+    return jnp.asarray(out)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_parity_with_xla_insert(seed):
+    rng = np.random.default_rng(seed)
+    hi, lo, active = _sorted_batch(rng, 512)
+    uniq = _dedup_first(hi, lo, active)
+
+    t_x, fresh_x, found_x, pend_x = hashset_insert(
+        hashset_new(CAP), hi, lo, uniq
+    )
+    t_p, fresh_p, found_p, pend_p = pallas_hashset_insert(
+        hashset_new(CAP), hi, lo, uniq, interpret=True
+    )
+    assert np.array_equal(np.asarray(fresh_x), np.asarray(fresh_p))
+    assert np.array_equal(np.asarray(found_x), np.asarray(found_p))
+    assert np.array_equal(np.asarray(pend_x), np.asarray(pend_p))
+    assert _table_keys(t_x) == _table_keys(t_p)
+
+
+def test_second_insert_reports_found():
+    rng = np.random.default_rng(7)
+    hi, lo, active = _sorted_batch(rng, 256, active_frac=1.0)
+    uniq = _dedup_first(hi, lo, active)
+    table, fresh1, _found1, _ = pallas_hashset_insert(
+        hashset_new(CAP), hi, lo, uniq, interpret=True
+    )
+    table, fresh2, found2, pend2 = pallas_hashset_insert(
+        table, hi, lo, uniq, interpret=True
+    )
+    assert not bool(np.asarray(fresh2).any())
+    assert np.array_equal(np.asarray(found2), np.asarray(uniq))
+    assert not bool(np.asarray(pend2).any())
+    assert int(np.asarray(fresh1).sum()) == int(np.asarray(uniq).sum())
+
+
+def test_in_batch_duplicates_report_found():
+    """Superset of the wave-unique contract: the kernel resolves in-batch
+    duplicates itself (second occurrence -> found)."""
+    rng = np.random.default_rng(3)
+    hi, lo, active = _sorted_batch(rng, 128, active_frac=1.0, dup_frac=0.25)
+    table, fresh, found, pend = pallas_hashset_insert(
+        hashset_new(CAP), hi, lo, jnp.asarray(active), interpret=True
+    )
+    hi_n, lo_n = np.asarray(hi), np.asarray(lo)
+    n_unique = len(set(zip(hi_n.tolist(), lo_n.tolist())))
+    assert int(np.asarray(fresh).sum()) == n_unique
+    assert int(np.asarray(found).sum()) == len(hi_n) - n_unique
+    assert not bool(np.asarray(pend).any())
+
+
+def test_clustered_keys_cross_tile_margin():
+    """Keys homing at the tile boundary probe into the apron of the next
+    tile; claims there must be visible to the next tile's window."""
+    # All keys home into the last row of tile 0: hi top bits == TILE_ROWS-1.
+    shift = 32 - (CAP.bit_length() - 1)
+    base_hi = np.uint32((TILE_ROWS - 1) << shift)
+    n = 64
+    hi = np.full(n, base_hi, np.uint32)
+    lo = np.arange(1, n + 1, dtype=np.uint32)
+    active = jnp.ones((n,), bool)
+    table, fresh, _found, pend = pallas_hashset_insert(
+        hashset_new(CAP), jnp.asarray(hi), jnp.asarray(lo), active,
+        interpret=True,
+    )
+    assert bool(np.asarray(fresh).all())
+    assert not bool(np.asarray(pend).any())
+    # Rows spill past the tile-0 boundary into tile 1's region.
+    t = np.asarray(table)
+    assert (t[TILE_ROWS : TILE_ROWS + n - 1, 1] != 0).any()
+    # A second pass over tile-1-homed keys must see those spilled rows.
+    hi2 = np.full(n, np.uint32(TILE_ROWS << shift), np.uint32)
+    lo2 = np.arange(1, n + 1, dtype=np.uint32)
+    table, fresh2, _f2, pend2 = pallas_hashset_insert(
+        table, jnp.asarray(hi2), jnp.asarray(lo2), active, interpret=True
+    )
+    assert bool(np.asarray(fresh2).all())
+    assert not bool(np.asarray(pend2).any())
+
+
+def test_probe_overflow_reports_pending():
+    """More same-home keys than MAX_PROBES slots -> the excess report
+    pending (the host grows the table), matching the XLA path."""
+    n = MAX_PROBES + 16
+    hi = np.zeros(n, np.uint32)  # all home at row 0
+    lo = np.arange(1, n + 1, dtype=np.uint32)
+    active = jnp.ones((n,), bool)
+    table, fresh, _found, pend = pallas_hashset_insert(
+        hashset_new(CAP), jnp.asarray(hi), jnp.asarray(lo), active,
+        interpret=True,
+    )
+    assert int(np.asarray(fresh).sum()) == MAX_PROBES
+    assert int(np.asarray(pend).sum()) == 16
