@@ -51,6 +51,7 @@ from ..ops.fingerprint import (
     fp_to_int,
 )
 from ..ops.hashset import hashset_insert, hashset_new
+from ..ops.ring import ring_export, ring_push, ring_rows, ring_take
 from .base import Checker
 
 _DEPTH_INF = (1 << 31) - 1
@@ -506,74 +507,24 @@ class TpuBfsChecker(Checker):
 
     def _pool_zero(self, capacity):
         """An empty device frontier pool (FIFO ring of pending states)."""
-        PC = capacity
-        init = self._model.packed_init_states()
-        z = jnp.zeros((PC,), jnp.uint32)
-        return {
-            "states": jax.tree_util.tree_map(
-                lambda x: jnp.zeros((PC,) + x.shape[1:], x.dtype), init
-            ),
-            "hi": z,
-            "lo": z,
-            "ebits": z,
-            "depth": jnp.zeros((PC,), jnp.int32),
-        }
+        return ring_rows(self._model, capacity)
 
     def _pool_push(self, pool, head, count, chunk):
         """Appends a host chunk's masked lanes at the ring tail."""
-        PC = self._pool_capacity
-        mask = chunk["mask"]
-        pos = jnp.cumsum(mask.astype(jnp.int32)) - 1
-        dest = jnp.where(mask, (head + count + pos) & (PC - 1), PC)
-
-        def scat(dst, src):
-            return dst.at[dest].set(src, mode="drop")
-
-        pool = {
-            "states": jax.tree_util.tree_map(
-                scat, pool["states"], chunk["states"]
-            ),
-            "hi": scat(pool["hi"], chunk["hi"]),
-            "lo": scat(pool["lo"], chunk["lo"]),
-            "ebits": scat(pool["ebits"], chunk["ebits"]),
-            "depth": scat(pool["depth"], chunk["depth"]),
-        }
-        return pool, count + mask.sum(dtype=jnp.int32)
+        return ring_push(
+            pool, head, count, chunk, chunk["mask"], self._pool_capacity
+        )
 
     def _pool_take(self, pool, head, count):
         """Dequeues up to ``F_max`` lanes from the ring head as a frontier."""
-        PC, F = self._pool_capacity, self._F_max
-        lanes = jnp.arange(F, dtype=jnp.int32)
-        take_n = jnp.minimum(count, F)
-        idx = (head + lanes) & (PC - 1)
-        frontier = {
-            "states": jax.tree_util.tree_map(
-                lambda x: x[idx], pool["states"]
-            ),
-            "hi": pool["hi"][idx],
-            "lo": pool["lo"][idx],
-            "ebits": pool["ebits"][idx],
-            "depth": pool["depth"][idx],
-            "mask": lanes < take_n,
-        }
-        return frontier, (head + take_n) & (PC - 1), count - take_n
+        return ring_take(
+            pool, head, count, self._pool_capacity, self._F_max
+        )
 
     def _pool_export(self, pool, head, count):
         """The ring contents in FIFO order (for checkpointing), padded to
         the full pool width with the valid-lane mask attached."""
-        PC = self._pool_capacity
-        lanes = jnp.arange(PC, dtype=jnp.int32)
-        idx = (head + lanes) & (PC - 1)
-        return {
-            "states": jax.tree_util.tree_map(
-                lambda x: x[idx], pool["states"]
-            ),
-            "hi": pool["hi"][idx],
-            "lo": pool["lo"][idx],
-            "ebits": pool["ebits"][idx],
-            "depth": pool["depth"][idx],
-            "mask": lanes < count,
-        }
+        return ring_export(pool, head, count, self._pool_capacity)
 
     def _grow_pool(self, pool, head, count):
         """Doubles the ring, preserving FIFO order (export + re-push). The
@@ -642,7 +593,10 @@ class TpuBfsChecker(Checker):
             "consumed_unique": jnp.int32(0),
             "max_depth": jnp.int32(0),
             "budget": budget,
-            "waves": jnp.int32(0),
+            # The pre-loop wave (out0) counts against the cap too, so a
+            # drain runs at most max_drain_waves waves total (the cap backs
+            # the checkpoint-durability guarantee).
+            "waves": jnp.int32(1),
         }
 
         def cond(c):
@@ -975,8 +929,13 @@ class TpuBfsChecker(Checker):
             undiscovered = np.array(
                 [p.name not in self._discoveries_fp for p in props]
             )
+            # Clamp: the budget rides device int32; a > 2^31-slot table
+            # must saturate, not overflow.
             budget = jnp.int32(
-                int(_MAX_LOAD * self._capacity) - self._unique_count
+                min(
+                    int(_MAX_LOAD * self._capacity) - self._unique_count,
+                    (1 << 31) - 1 - B,
+                )
             )
             if not compiled:
                 # Compile ahead of the first real call so warmup measures

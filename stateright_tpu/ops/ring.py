@@ -1,0 +1,81 @@
+"""Device-resident FIFO ring of frontier rows, shared by the deep drains.
+
+Both ``TpuBfsChecker`` and ``ShardedTpuBfsChecker`` keep their pending
+frontier in a fixed-capacity ring of packed-state rows living in device
+memory: waves dequeue up to a frontier's width from the head and append
+fresh rows at the tail, entirely inside the compiled loop. One
+implementation of the wrap arithmetic (cumsum-compacted masked scatter on
+push, masked gather on take, export-in-FIFO-order for growth and
+checkpoints) keeps the two checkers in lockstep.
+
+``capacity`` must be a power of two (callers size rings with
+``_pow2ceil``); rows are dicts ``{states: pytree, hi, lo, ebits, depth}``
+with a leading batch axis, plus a ``mask`` of valid lanes where noted.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["ring_rows", "ring_push", "ring_take", "ring_export"]
+
+_ROW_KEYS = ("hi", "lo", "ebits", "depth")
+
+
+def ring_rows(model, width: int):
+    """Zeroed frontier-row storage of the given width for ``model``'s
+    packed states."""
+    init = model.packed_init_states()
+    z = jnp.zeros((width,), jnp.uint32)
+    return {
+        "states": jax.tree_util.tree_map(
+            lambda x: jnp.zeros((width,) + x.shape[1:], x.dtype), init
+        ),
+        "hi": z,
+        "lo": z,
+        "ebits": z,
+        "depth": jnp.zeros((width,), jnp.int32),
+    }
+
+
+def ring_push(pool, head, count, rows, mask, capacity: int):
+    """Appends ``rows``'s masked lanes at the ring tail (any mask pattern);
+    returns ``(pool, count)``."""
+    pos = jnp.cumsum(mask.astype(jnp.int32)) - 1
+    dest = jnp.where(mask, (head + count + pos) & (capacity - 1), capacity)
+
+    def scat(dst, src):
+        return dst.at[dest].set(src, mode="drop")
+
+    pool = {
+        "states": jax.tree_util.tree_map(scat, pool["states"], rows["states"]),
+        **{k: scat(pool[k], rows[k]) for k in _ROW_KEYS},
+    }
+    return pool, count + mask.sum(dtype=jnp.int32)
+
+
+def ring_take(pool, head, count, capacity: int, width: int):
+    """Dequeues up to ``width`` lanes from the ring head as a frontier
+    (masked); returns ``(frontier, head, count)``."""
+    lanes = jnp.arange(width, dtype=jnp.int32)
+    take_n = jnp.minimum(count, width)
+    idx = (head + lanes) & (capacity - 1)
+    frontier = {
+        "states": jax.tree_util.tree_map(lambda x: x[idx], pool["states"]),
+        **{k: pool[k][idx] for k in _ROW_KEYS},
+        "mask": lanes < take_n,
+    }
+    return frontier, (head + take_n) & (capacity - 1), count - take_n
+
+
+def ring_export(pool, head, count, capacity: int):
+    """The ring contents in FIFO order, padded to the full capacity with
+    the valid-lane mask attached (for growth re-push and checkpoints)."""
+    lanes = jnp.arange(capacity, dtype=jnp.int32)
+    idx = (head + lanes) & (capacity - 1)
+    return {
+        "states": jax.tree_util.tree_map(lambda x: x[idx], pool["states"]),
+        **{k: pool[k][idx] for k in _ROW_KEYS},
+        "mask": lanes < count,
+    }

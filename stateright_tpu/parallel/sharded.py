@@ -51,6 +51,7 @@ from ..core.path import Path
 from ..native import make_fingerprint_store
 from ..ops.fingerprint import fingerprint_state, fp64_pairs, fp_to_int
 from ..ops.hashset import MAX_PROBES, hashset_insert
+from ..ops.ring import ring_export, ring_push, ring_rows, ring_take
 from .base_mesh import default_mesh
 from ..checker.base import Checker
 from ..checker.tpu import (
@@ -106,6 +107,9 @@ class ShardedTpuBfsChecker(Checker):
         checkpoint_every_chunks=32,
         checkpoint_min_interval_s=0.0,
         resume_from=None,
+        max_drain_waves=100_000,
+        drain_log_factor=8,
+        pool_factor=16,
     ):
         model = options.model
         if not isinstance(model, BatchableModel):
@@ -149,6 +153,19 @@ class ShardedTpuBfsChecker(Checker):
         self._checkpoint_every = max(1, checkpoint_every_chunks)
         self._checkpoint_min_interval = checkpoint_min_interval_s
         self._resume_from = resume_from
+        # Deep drain (device frontier rings; see _deep_drain_local). As in
+        # TpuBfsChecker: 1 disables, and durability caps waves-per-drain.
+        self._max_drain_waves = max(1, max_drain_waves)
+        if checkpoint_path is not None:
+            self._max_drain_waves = min(
+                self._max_drain_waves, max(2, checkpoint_every_chunks)
+            )
+        self._Ll = max(
+            max(1, drain_log_factor) * self._F_loc, self._F_loc * self._A
+        )
+        self._PCl = _pow2ceil(
+            max(max(1, pool_factor) * self._F_loc, self._F_loc * self._A)
+        )
 
         self._state_count = 0
         self._unique_count = 0
@@ -189,6 +206,33 @@ class ShardedTpuBfsChecker(Checker):
                 self._rehash_local,
                 mesh=self._mesh,
                 in_specs=(P("fp"), P("fp")),
+                out_specs=P("fp"),
+                check_vma=False,
+            )
+        )
+        self._jit_deep_drain = jax.jit(
+            shard_map(
+                self._deep_drain_local,
+                mesh=self._mesh,
+                in_specs=(P("fp"),) * 4 + (P(), P(), P()),
+                out_specs=P("fp"),
+                check_vma=False,
+            )
+        )
+        self._jit_ring_push = jax.jit(
+            shard_map(
+                self._push_local,
+                mesh=self._mesh,
+                in_specs=(P("fp"),) * 4,
+                out_specs=P("fp"),
+                check_vma=False,
+            )
+        )
+        self._jit_ring_export = jax.jit(
+            shard_map(
+                self._ring_export_local,
+                mesh=self._mesh,
+                in_specs=(P("fp"),) * 3,
                 out_specs=P("fp"),
                 check_vma=False,
             )
@@ -304,11 +348,29 @@ class ShardedTpuBfsChecker(Checker):
         }
 
     def _wave_local(self, table, states, hi, lo, ebits, depth, mask, depth_cap):
+        """shard_map wrapper: unwraps the leading per-device axis, runs the
+        wave core, and re-wraps scalars for ``out_specs=P("fp")``."""
+        out = self._wave_core(
+            table[0], states, hi, lo, ebits, depth, mask, depth_cap
+        )
+        wrapped = dict(out)
+        wrapped["table"] = out["table"][None]
+        for k in ("generated", "n_new", "overflow", "max_depth"):
+            wrapped[k] = out[k][None]
+        if self._properties:
+            for k in ("prop_hit", "prop_hi", "prop_lo"):
+                wrapped[k] = out[k][None]
+        return wrapped
+
+    def _wave_core(self, table_loc, states, hi, lo, ebits, depth, mask, depth_cap):
+        """One expansion wave on local (per-device) arrays: expand,
+        fingerprint, pre-dedup, all-to-all claim-insert, compact. Scalars
+        come back unwrapped; the deep drain and the wave-at-a-time wrapper
+        share this."""
         model = self._model
         A = self._A
         F = hi.shape[0]  # local slice width
         B = F * A
-        table_loc = table[0]
         eval_mask = mask & (depth < depth_cap)
 
         cond_vals = [jax.vmap(c)(states) for c in self._conditions]
@@ -360,11 +422,11 @@ class ShardedTpuBfsChecker(Checker):
             lambda x: x[src_idx], cand_flat
         )
         out = {
-            "table": table_loc[None],
-            "generated": generated[None],
-            "n_new": fresh.sum(dtype=jnp.int32)[None],
-            "overflow": overflow[None],
-            "max_depth": jnp.max(jnp.where(mask, depth, 0))[None],
+            "table": table_loc,
+            "generated": generated,
+            "n_new": fresh.sum(dtype=jnp.int32),
+            "overflow": overflow,
+            "max_depth": jnp.max(jnp.where(mask, depth, 0)),
             "new_states": new_states,
             "new_hi": zu.at[out_slot].set(chi, mode="drop"),
             "new_lo": zu.at[out_slot].set(clo, mode="drop"),
@@ -394,9 +456,9 @@ class ShardedTpuBfsChecker(Checker):
             fhis.append(hi[idx])
             flos.append(lo[idx])
         if self._properties:
-            out["prop_hit"] = jnp.stack(hits)[None]
-            out["prop_hi"] = jnp.stack(fhis)[None]
-            out["prop_lo"] = jnp.stack(flos)[None]
+            out["prop_hit"] = jnp.stack(hits)
+            out["prop_hi"] = jnp.stack(fhis)
+            out["prop_lo"] = jnp.stack(flos)
         return out
 
     def _rehash_local(self, old_table, new_table):
@@ -407,6 +469,274 @@ class ShardedTpuBfsChecker(Checker):
             new, old[:, 0], old[:, 1], active
         )
         return {"table": new[None], "overflow": pending.sum()[None]}
+
+    # -- deep drain: per-device frontier rings + all-to-all row balancing --
+
+    def _ring_export_local(self, pool, head, count):
+        """Local ring contents in FIFO order, mask attached (shard_map
+        entry)."""
+        return ring_export(pool, head[0], count[0], self._PCl)
+
+    def _push_local(self, pool, head, count, rows):
+        """shard_map entry: pushes a host chunk slice into the local ring."""
+        pool, cnt = ring_push(
+            pool, head[0], count[0], rows, rows["mask"], self._PCl
+        )
+        return {"pool": pool, "count": cnt[None]}
+
+    def _balance_exchange(self, rows, n_new):
+        """Round-robin all-to-all of the fresh (compacted-prefix) frontier
+        rows: lane ``j`` goes to device ``j % n``. Fresh states are born on
+        the device that expanded their parent; without this exchange a
+        device that seeds the search keeps every descendant and the rest of
+        the mesh idles. Round-robin balances by construction (each device
+        receives within ±1 of the mean from every sender) with a fixed
+        ``ceil(B/n)`` per-pair quota — no data-dependent shapes."""
+        n = self._n
+        B = rows["hi"].shape[0]
+        q = -(-B // n)
+        j = jnp.arange(B, dtype=jnp.int32)
+        dest = jnp.where(j < n_new, (j % n) * q + j // n, n * q)
+
+        def scat(x):
+            z = jnp.zeros((n * q,) + x.shape[1:], x.dtype)
+            return z.at[dest].set(x, mode="drop")
+
+        def xch(x):
+            return jax.lax.all_to_all(
+                x.reshape((n, q) + x.shape[1:]),
+                "fp",
+                split_axis=0,
+                concat_axis=0,
+                tiled=True,
+            ).reshape((n * q,) + x.shape[1:])
+
+        send_mask = (
+            jnp.zeros((n * q,), jnp.uint32)
+            .at[dest]
+            .set(jnp.ones((B,), jnp.uint32), mode="drop")
+        )
+        recv = {
+            k: (
+                jax.tree_util.tree_map(lambda x: xch(scat(x)), v)
+                if k == "states"
+                else xch(scat(v))
+            )
+            for k, v in rows.items()
+        }
+        recv_mask = xch(send_mask) != 0
+        return recv, recv_mask
+
+    def _drain_decide(self, out, count_after, log_n, budget, waves, gen_acc, undiscovered):
+        """The globally-agreed continue flag (identical on every device —
+        all inputs are psums or replicated)."""
+        n_new = out["n_new"]
+        g_n_new = jax.lax.psum(n_new, "fp")
+        g_count = jax.lax.psum(count_after, "fp")
+        g_overflow = jax.lax.psum(out["overflow"], "fp")
+        ok = (g_n_new > 0) | (g_count > 0)
+        ok &= g_overflow == 0
+        if self._properties:
+            hit = (out["prop_hit"] & undiscovered).any()
+            ok &= jax.lax.psum(hit.astype(jnp.int32), "fp") == 0
+        # Generator-side log room for appending this wave's fresh rows.
+        no_log_room = (log_n + n_new > self._Ll).astype(jnp.int32)
+        ok &= jax.lax.psum(no_log_room, "fp") == 0
+        # Ring room for pushing the rows this device just received.
+        recv_n = out["recv_mask"].sum(dtype=jnp.int32)
+        no_ring_room = (count_after + recv_n > self._PCl).astype(jnp.int32)
+        ok &= jax.lax.psum(no_ring_room, "fp") == 0
+        ok &= budget - g_n_new >= jnp.int32(self._G * self._A)
+        ok &= waves < self._max_drain_waves
+        ok &= gen_acc < jnp.int32(1 << 30)
+        return ok
+
+    def _deep_drain_local(
+        self, table, pool, head, count, undiscovered, budget, depth_cap
+    ):
+        """The sharded deep drain: consecutive waves inside one device
+        ``while_loop``. Each iteration appends the previous wave's fresh
+        rows to the parent-fp log (generator side), pushes the rows this
+        device *received* in the balance exchange onto its ring, dequeues
+        the next local frontier, and expands it. Exit is a global vote
+        (psum) — log full, ring full, table budget, hash overflow, or an
+        undiscovered property hit — mirroring ``TpuBfsChecker``'s deep
+        drain with collectives in place of single-device checks."""
+        F, A, n = self._F_loc, self._A, self._n
+        B = F * A
+        Ll = self._Ll
+
+        table_loc = table[0]
+        head0 = head[0]
+        count0 = count[0]
+        budget0 = budget
+
+        def wave_plus(tbl, fr):
+            out = self._wave_core(
+                tbl,
+                fr["states"],
+                fr["hi"],
+                fr["lo"],
+                fr["ebits"],
+                fr["depth"],
+                fr["mask"],
+                depth_cap,
+            )
+            rows = {
+                "states": out["new_states"],
+                "hi": out["new_hi"],
+                "lo": out["new_lo"],
+                "ebits": out["new_ebits"],
+                "depth": out["new_depth"],
+            }
+            recv, recv_mask = self._balance_exchange(rows, out["n_new"])
+            out["recv"] = recv
+            out["recv_mask"] = recv_mask
+            return out
+
+        fr0, head1, count1 = ring_take(
+            {k: pool[k] for k in ("states", "hi", "lo", "ebits", "depth")},
+            head0,
+            count0,
+            self._PCl,
+            F,
+        )
+        out0 = wave_plus(table_loc, fr0)
+        zl = jnp.zeros((Ll,), jnp.uint32)
+        log0 = {
+            "child_hi": zl,
+            "child_lo": zl,
+            "parent_hi": zl,
+            "parent_lo": zl,
+        }
+        if self._symmetry_enabled:
+            log0.update(key_hi=zl, key_lo=zl)
+        carry = {
+            "pool": {k: pool[k] for k in ("states", "hi", "lo", "ebits", "depth")},
+            "head": head1,
+            "count": count1,
+            "frontier": fr0,
+            "out": out0,
+            "log": log0,
+            "log_n": jnp.int32(0),
+            "generated": jnp.int32(0),
+            "consumed_unique": jnp.int32(0),
+            "max_depth": jnp.int32(0),
+            "budget": budget0,
+            # The pre-loop wave (out0) counts against the cap too, so a
+            # drain runs at most max_drain_waves waves total (the cap backs
+            # the checkpoint-durability guarantee).
+            "waves": jnp.int32(1),
+            "go": self._drain_decide(
+                out0, count1, jnp.int32(0), budget0, jnp.int32(1),
+                jnp.int32(0), undiscovered,
+            ),
+        }
+
+        def cond(c):
+            return c["go"]
+
+        def body(c):
+            o = c["out"]
+            n_new = o["n_new"]
+            lanes = jnp.arange(B, dtype=jnp.int32)
+            valid = lanes < n_new
+            slot = jnp.where(valid, c["log_n"] + lanes, Ll)
+            log = dict(c["log"])
+            log["child_hi"] = log["child_hi"].at[slot].set(
+                o["new_hi"], mode="drop"
+            )
+            log["child_lo"] = log["child_lo"].at[slot].set(
+                o["new_lo"], mode="drop"
+            )
+            log["parent_hi"] = log["parent_hi"].at[slot].set(
+                o["parent_hi"], mode="drop"
+            )
+            log["parent_lo"] = log["parent_lo"].at[slot].set(
+                o["parent_lo"], mode="drop"
+            )
+            if self._symmetry_enabled:
+                log["key_hi"] = log["key_hi"].at[slot].set(
+                    o["new_khi"], mode="drop"
+                )
+                log["key_lo"] = log["key_lo"].at[slot].set(
+                    o["new_klo"], mode="drop"
+                )
+            pool, count = ring_push(
+                c["pool"], c["head"], c["count"], o["recv"], o["recv_mask"],
+                self._PCl,
+            )
+            frontier, head, count = ring_take(
+                pool, c["head"], count, self._PCl, F
+            )
+            out = wave_plus(o["table"], frontier)
+            log_n = c["log_n"] + n_new
+            budget = c["budget"] - jax.lax.psum(n_new, "fp")
+            waves = c["waves"] + 1
+            gen_acc = c["generated"] + o["generated"]
+            return {
+                "pool": pool,
+                "head": head,
+                "count": count,
+                "frontier": frontier,
+                "out": out,
+                "log": log,
+                "log_n": log_n,
+                "generated": gen_acc,
+                "consumed_unique": c["consumed_unique"] + n_new,
+                "max_depth": jnp.maximum(c["max_depth"], o["max_depth"]),
+                "budget": budget,
+                "waves": waves,
+                "go": self._drain_decide(
+                    out, count, log_n, budget, waves, gen_acc, undiscovered
+                ),
+            }
+
+        res = jax.lax.while_loop(cond, body, carry)
+        o = res["out"]
+        out = {
+            "pool": res["pool"],
+            "head": res["head"][None],
+            "count": res["count"][None],
+            "frontier": res["frontier"],
+            "final": {
+                "table": o["table"][None],
+                "recv": o["recv"],
+                "recv_mask": o["recv_mask"],
+                "new_hi": o["new_hi"],
+                "new_lo": o["new_lo"],
+                "parent_hi": o["parent_hi"],
+                "parent_lo": o["parent_lo"],
+            },
+            "drain_stats": jnp.stack(
+                [
+                    res["log_n"],
+                    res["generated"],
+                    res["consumed_unique"],
+                    res["max_depth"],
+                    res["waves"],
+                    res["count"],
+                    o["n_new"],
+                    o["generated"],
+                    o["overflow"],
+                    o["max_depth"],
+                ]
+            )[None],
+        }
+        if self._symmetry_enabled:
+            out["final"]["new_khi"] = o["new_khi"]
+            out["final"]["new_klo"] = o["new_klo"]
+        cols = ["child_hi", "child_lo", "parent_hi", "parent_lo"]
+        if self._symmetry_enabled:
+            cols += ["key_hi", "key_lo"]
+        out["log_pack"] = jnp.stack([res["log"][c] for c in cols], axis=0)[
+            None
+        ]
+        if self._properties:
+            out["prop_hit"] = o["prop_hit"][None]
+            out["prop_hi"] = o["prop_hi"][None]
+            out["prop_lo"] = o["prop_lo"][None]
+        return out
 
     # -- host side ---------------------------------------------------------
 
@@ -513,8 +843,6 @@ class ShardedTpuBfsChecker(Checker):
         return chunk
 
     def _explore(self):
-        props = self._properties
-        n, G, A = self._n, self._G, self._A
         self._pool = deque()
         self._pool_count = 0
         if self._resume_from is not None:
@@ -522,6 +850,24 @@ class ShardedTpuBfsChecker(Checker):
         else:
             table = self._seed()
         depth_cap = jnp.int32(self._depth_cap)
+        # Deep drain is off for visitors, target counts, and depth caps:
+        # ring scheduling is only approximately global-FIFO across devices,
+        # so a depth-capped run could first reach a state via a longer path
+        # and prune expansions a strict BFS would keep. (Without a cap the
+        # visited SET is order-independent — counts stay exact.)
+        if (
+            self._max_drain_waves > 1
+            and self._visitor is None
+            and self._target_state_count is None
+            and self._depth_cap == _DEPTH_INF
+        ):
+            self._explore_deep(table, depth_cap)
+        else:
+            self._explore_waves(table, depth_cap)
+
+    def _explore_waves(self, table, depth_cap):
+        props = self._properties
+        n, G, A = self._n, self._G, self._A
 
         chunks = 0
         last_checkpoint = time.perf_counter()
@@ -596,6 +942,238 @@ class ShardedTpuBfsChecker(Checker):
                 attempt += 1
             # Re-ingest fresh rows for the next chunks.
             del dev
+
+    # -- deep-drain host loop ---------------------------------------------
+
+    def _new_pool(self):
+        W = self._n * self._PCl
+        return jax.jit(
+            lambda: ring_rows(self._model, W), out_shardings=self._shard
+        )()
+
+    def _new_heads(self):
+        return jax.jit(
+            lambda: jnp.zeros((self._n,), jnp.int32),
+            out_shardings=self._shard,
+        )()
+
+    def _feed_rings(self, pool, head, count, ring_est):
+        """Moves host-pool rows into the device rings, growing them when
+        the next global chunk might not fit. Returns updated state."""
+        G = self._G
+        while self._pool_count:
+            if ring_est + self._F_loc > self._PCl:
+                # The host bound overcounts (F_loc per chunk regardless of
+                # occupancy); refresh it from the device before paying for
+                # a ring doubling and its retrace.
+                ring_est = int(np.asarray(count).max())
+                if ring_est + self._F_loc > self._PCl:
+                    pool, head, count = self._grow_rings(pool, head, count)
+            chunk = self._pool_take(G)
+            dev = self._put_chunk(chunk)
+            out = self._jit_ring_push(pool, head, count, dev)
+            pool, count = out["pool"], out["count"]
+            ring_est += self._F_loc
+        return pool, head, count, ring_est
+
+    def _grow_rings(self, pool, head, count):
+        """Doubles every device's ring (local export + re-push — rows never
+        change device, so growth needs no communication)."""
+        exported = self._jit_ring_export(pool, head, count)
+        self._PCl *= 2
+        pool = self._new_pool()
+        head = self._new_heads()
+        out = self._jit_ring_push(pool, head, self._new_heads(), exported)
+        return out["pool"], head, out["count"]
+
+    def _explore_deep(self, table, depth_cap):
+        props = self._properties
+        if not props:
+            return
+        n, G, A = self._n, self._G, self._A
+        pool = self._new_pool()
+        head = self._new_heads()
+        count = self._new_heads()
+        ring_est = 0  # conservative host bound on the fullest ring
+        drains = 0
+        compiled = False
+        last_checkpoint = time.perf_counter()
+        while True:
+            if len(self._discoveries_fp) == len(props):
+                break
+            pool, head, count, ring_est = self._feed_rings(
+                pool, head, count, ring_est
+            )
+            if ring_est == 0:
+                break
+            if (
+                self._checkpoint_path is not None
+                and drains
+                and (time.perf_counter() - last_checkpoint)
+                >= self._checkpoint_min_interval
+            ):
+                self._checkpoint_rings(pool, head, count)
+                last_checkpoint = time.perf_counter()
+            drains += 1
+            B_glob = G * A
+            if (self._unique_count + B_glob) > _MAX_LOAD * n * self._cap_loc:
+                table = self._grow_table(
+                    table,
+                    _pow2ceil(
+                        int((self._unique_count + B_glob) / (_MAX_LOAD * n))
+                    ),
+                )
+            undiscovered = np.array(
+                [p.name not in self._discoveries_fp for p in props]
+            )
+            # Clamp: the budget rides device int32; a huge global table
+            # (> 2^31 slots across the mesh) must saturate, not overflow.
+            budget = jnp.int32(
+                min(
+                    int(_MAX_LOAD * n * self._cap_loc) - self._unique_count,
+                    (1 << 31) - 1 - G * A,
+                )
+            )
+            args = (
+                table,
+                pool,
+                head,
+                count,
+                jnp.asarray(undiscovered),
+                budget,
+                depth_cap,
+            )
+            if not compiled:
+                # AOT-compile so the first drain (which may run the whole
+                # exploration) doesn't fold into any warmup measurement.
+                self._jit_deep_drain.lower(*args).compile()
+                compiled = True
+            with jax.profiler.StepTraceAnnotation(
+                "sharded_bfs.drain", step_num=drains
+            ):
+                res = self._jit_deep_drain(*args)
+                dstats = np.asarray(res["drain_stats"])  # (n, 10)
+            self._state_count += int(dstats[:, 1].sum())
+            self._unique_count += int(dstats[:, 2].sum())
+            self._max_depth = max(self._max_depth, int(dstats[:, 3].max()))
+            pool, head, count = res["pool"], res["head"], res["count"]
+            ring_est = int(dstats[:, 5].max())
+            # The whole drain's parent-fp stream: one (n, 6, Ll) transfer,
+            # sliced per device by its log_n.
+            max_log = int(dstats[:, 0].max())
+            if max_log:
+                pack = np.asarray(res["log_pack"][:, :, :max_log])
+                for d in range(n):
+                    ln = int(dstats[d, 0])
+                    if ln:
+                        self._wave_log.append(
+                            (
+                                fp64_pairs(pack[d, 0, :ln], pack[d, 1, :ln]),
+                                fp64_pairs(pack[d, 2, :ln], pack[d, 3, :ln]),
+                            )
+                        )
+                        if self._symmetry_enabled:
+                            self._key_log.append(
+                                fp64_pairs(pack[d, 4, :ln], pack[d, 5, :ln])
+                            )
+            table, pool, head, count, ring_est = self._consume_final(
+                res, dstats, table, pool, head, count, ring_est, depth_cap
+            )
+
+    def _consume_final(
+        self, res, dstats, table, pool, head, count, ring_est, depth_cap
+    ):
+        """Applies the drain's final (unconsumed) wave host-side: counters,
+        discoveries, parent-fp log, ring push of the exchanged rows, and
+        the table-growth overflow retry."""
+        props = self._properties
+        n = self._n
+        final = res["final"]
+        table = final["table"]
+        self._state_count += int(dstats[:, 7].sum())
+        self._max_depth = max(self._max_depth, int(dstats[:, 9].max()))
+        if props:
+            hit = np.asarray(res["prop_hit"])
+            phi = np.asarray(res["prop_hi"])
+            plo = np.asarray(res["prop_lo"])
+            for i, p in enumerate(props):
+                if p.name in self._discoveries_fp:
+                    continue
+                for d in range(n):
+                    if hit[d, i]:
+                        self._discoveries_fp[p.name] = fp_to_int(
+                            phi[d, i], plo[d, i]
+                        )
+                        break
+        # Log + count the final wave's fresh rows (generator side).
+        n_new = dstats[:, 6]
+        total_new = int(n_new.sum())
+        self._unique_count += total_new
+        if total_new:
+            B = self._F_loc * self._A
+            hi = np.asarray(final["new_hi"]).reshape(n, B)
+            lo = np.asarray(final["new_lo"]).reshape(n, B)
+            phi_ = np.asarray(final["parent_hi"]).reshape(n, B)
+            plo_ = np.asarray(final["parent_lo"]).reshape(n, B)
+            sel = np.zeros((n, B), bool)
+            for d in range(n):
+                sel[d, : int(n_new[d])] = True
+            self._wave_log.append(
+                (fp64_pairs(hi[sel], lo[sel]), fp64_pairs(phi_[sel], plo_[sel]))
+            )
+            if self._symmetry_enabled:
+                khi = np.asarray(final["new_khi"]).reshape(n, B)
+                klo = np.asarray(final["new_klo"]).reshape(n, B)
+                self._key_log.append(fp64_pairs(khi[sel], klo[sel]))
+            # Push the exchanged rows into the rings (device-side; the
+            # exchange already balanced them round-robin).
+            recv_per_dev = final["recv_mask"].shape[0] // n
+            if ring_est + recv_per_dev > self._PCl:
+                pool, head, count = self._grow_rings(pool, head, count)
+            rows = dict(final["recv"])
+            rows["mask"] = final["recv_mask"]
+            out = self._jit_ring_push(pool, head, count, rows)
+            pool, count = out["pool"], out["count"]
+            ring_est += recv_per_dev
+        # Overflow retry: grow the table and re-expand the saved frontier
+        # through the wave path (fresh rows land in the host pool).
+        if int(dstats[:, 8].sum()):
+            fr = res["frontier"]
+            while True:
+                table = self._grow_table(table, self._cap_loc * 2)
+                wave = self._jit_wave(
+                    table,
+                    fr["states"],
+                    fr["hi"],
+                    fr["lo"],
+                    fr["ebits"],
+                    fr["depth"],
+                    fr["mask"],
+                    depth_cap,
+                )
+                table = wave["table"]
+                self._harvest(wave)
+                if not int(np.asarray(wave["overflow"]).sum()):
+                    break
+        return table, pool, head, count, ring_est
+
+    def _checkpoint_rings(self, pool, head, count):
+        """Deep-mode checkpoint: exports the rings into one host row-batch
+        and saves it alongside any host-pool leftovers."""
+        exported = self._jit_ring_export(pool, head, count)
+        mask = np.asarray(exported["mask"])
+        batch = {
+            k: (
+                jax.tree_util.tree_map(lambda x: np.asarray(x)[mask], v)
+                if k == "states"
+                else np.asarray(v)[mask]
+            )
+            for k, v in exported.items()
+            if k != "mask"
+        }
+        self.save_checkpoint(
+            self._checkpoint_path, list(self._pool) + [batch]
+        )
 
     def _seed(self):
         """Fingerprints + dedup-inserts the initial states; returns the

@@ -117,3 +117,41 @@ def test_sharded_submesh_sizes(n_dev):
     )
     assert checker.worker_error() is None
     assert checker.unique_state_count() == 288
+
+
+def test_sharded_deep_drain_tiny_rings_and_log():
+    """Forces the deep drain through its host-exit machinery: a tiny log
+    (many log-full exits), tiny rings (growth via export + re-push), and a
+    small waves cap — the exact count must survive all of it."""
+    checker = (
+        TwoPhaseSys(5)
+        .checker()
+        .spawn_sharded_tpu_bfs(
+            frontier_per_device=32,
+            table_capacity_per_device=512,
+            drain_log_factor=1,
+            pool_factor=1,
+            max_drain_waves=3,
+        )
+        .join()
+    )
+    assert checker.worker_error() is None
+    assert checker.unique_state_count() == 8832
+    checker.assert_properties()
+
+
+def test_sharded_waves_mode_still_exact():
+    """max_drain_waves=1 disables the deep drain; the wave-at-a-time path
+    must produce the same oracle count."""
+    checker = (
+        TwoPhaseSys(3)
+        .checker()
+        .spawn_sharded_tpu_bfs(
+            frontier_per_device=64,
+            table_capacity_per_device=256,
+            max_drain_waves=1,
+        )
+        .join()
+    )
+    assert checker.worker_error() is None
+    assert checker.unique_state_count() == 288

@@ -232,3 +232,24 @@ def test_unbatchable_model_rejected():
     model = FnModel(lambda s, out: out.append(0) if s is None else None)
     with pytest.raises(TypeError, match="BatchableModel"):
         model.checker().spawn_tpu_bfs()
+
+
+def test_deep_drain_tiny_ring_and_log_exact():
+    """Forces the deep drain's stress machinery — ring growth
+    (export + re-push), log-full drain exits, and host-queue spill
+    re-ingest — on a tiny ring/log; the exact oracle count must survive."""
+    checker = (
+        TwoPhaseSys(5)
+        .checker()
+        .spawn_tpu_bfs(
+            frontier_capacity=32,
+            table_capacity=1 << 12,
+            drain_log_factor=1,
+            pool_factor=1,
+            max_drain_waves=3,
+        )
+        .join()
+    )
+    assert checker.worker_error() is None
+    assert checker.unique_state_count() == 8832
+    checker.assert_properties()
