@@ -159,27 +159,35 @@ def _run_leg(leg: str, pin_cpu: bool):
         from stateright_tpu.models.raft import RaftModelCfg
 
         # Depth cap (not a state-count target) keeps the workload
-        # deterministic AND deep-drain-eligible. Frontier kept modest:
-        # raft-5 packs ~1.3KB/state and expands 125 actions/lane, so
-        # candidate buffers scale at ~0.17GB per 1024 lanes.
+        # deterministic AND deep-drain-eligible; 29,522 is the pinned
+        # depth-7 oracle (measured on the CPU backend, single-device deep
+        # drain is strict-FIFO so the cap semantics are exact). Frontier
+        # kept modest: raft-5 packs ~1.3KB/state and expands 125
+        # actions/lane, so candidate buffers scale at ~0.3GB per 2048
+        # lanes.
         t0 = time.time()
         checker = (
             RaftModelCfg(server_count=5, max_term=1, lossy=True)
             .into_model()
             .checker()
-            .target_max_depth(6)
-            .spawn_tpu_bfs(frontier_capacity=1 << 10, table_capacity=1 << 20)
+            .target_max_depth(7)
+            .spawn_tpu_bfs(frontier_capacity=1 << 11, table_capacity=1 << 21)
             .join()
         )
         dt = time.time() - t0
         err = checker.worker_error()
         if err is not None:
             raise err
+        if checker.unique_state_count() != 29_522:
+            raise AssertionError(
+                f"raft5-depth7 count mismatch: "
+                f"{checker.unique_state_count()} != 29522"
+            )
         out.update(
-            unique=checker.unique_state_count(),
+            unique=29_522,
             wall_s=dt,
             warmup_s=checker.warmup_seconds or 0.0,
-            rate=checker.unique_state_count()
+            rate=29_522
             / max(dt - (checker.warmup_seconds or 0.0), 1e-9),
         )
     else:

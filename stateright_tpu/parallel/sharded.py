@@ -27,9 +27,11 @@ The host loop only moves compacted *new-state* batches through a queue
 ``JobBroker``) and ingests (child fp, parent fp) pairs for TLC-style path
 reconstruction, identical to the single-device ``TpuBfsChecker``.
 
-Multi-host note: the same program runs unchanged under ``jax.distributed``
-initialization — the mesh then spans hosts and the all-to-all rides
-ICI within a slice and DCN across slices; nothing here is host-count aware.
+Multi-host: the same program runs under ``jax.distributed`` — the mesh
+spans hosts, all-to-all rides ICI within a slice and DCN across slices,
+every controller executes this same host loop in lockstep (host pulls
+allgather; checkpoints written by process 0). Exercised end-to-end on a
+2-process mesh in ``tests/test_multihost.py``.
 """
 
 from __future__ import annotations
@@ -183,6 +185,10 @@ class ShardedTpuBfsChecker(Checker):
 
         self._shard = NamedSharding(self._mesh, P("fp"))
         self._replicated = NamedSharding(self._mesh, P())
+        # Multi-controller (multi-host) mode: under ``jax.distributed`` the
+        # mesh spans processes and device arrays are only partially
+        # addressable from each host — host pulls must allgather.
+        self._mp = jax.process_count() > 1
         self._jit_wave = jax.jit(
             shard_map(
                 self._wave_local,
@@ -548,7 +554,12 @@ class ShardedTpuBfsChecker(Checker):
         ok &= jax.lax.psum(no_ring_room, "fp") == 0
         ok &= budget - g_n_new >= jnp.int32(self._G * self._A)
         ok &= waves < self._max_drain_waves
-        ok &= gen_acc < jnp.int32(1 << 30)
+        # gen_acc is a per-device local counter; the vote must be identical
+        # on every device or one device exits the collective-bearing loop
+        # while peers keep calling all_to_all (mesh hang). pmax (not psum:
+        # a psum over many devices could itself wrap int32) exits when ANY
+        # device's accumulator nears the wrap.
+        ok &= jax.lax.pmax(gen_acc, "fp") < jnp.int32(1 << 30)
         return ok
 
     def _deep_drain_local(
@@ -763,11 +774,25 @@ class ShardedTpuBfsChecker(Checker):
         while self._cap_loc < min_cap_loc:
             self._cap_loc *= 2
         out = self._jit_rehash(table, self._new_table())
-        if int(np.asarray(out["overflow"]).sum()):
+        if int(self._pull(out["overflow"]).sum()):
             raise RuntimeError("sharded rehash overflowed probe cap")
         return out["table"]
 
+    def _pull(self, x):
+        """A numpy view of a device array. Multi-controller: the array's
+        shards live on several hosts, so gather them first (every process
+        runs this same host loop in lockstep — SPMD over hosts — and gets
+        identical values, keeping all host-side decisions consistent)."""
+        if self._mp:
+            from jax.experimental import multihost_utils
+
+            return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+        return np.asarray(x)
+
     def _put_chunk(self, arrs):
+        # Multi-controller note: every process passes the identical host
+        # value, so device_put shards out each host's addressable slice
+        # consistently.
         return jax.tree_util.tree_map(
             lambda x: jax.device_put(jnp.asarray(x), self._shard), arrs
         )
@@ -916,14 +941,14 @@ class ShardedTpuBfsChecker(Checker):
                 )
                 table = wave["table"]
                 if attempt == 0:
-                    self._state_count += int(np.asarray(wave["generated"]).sum())
+                    self._state_count += int(self._pull(wave["generated"]).sum())
                     self._max_depth = max(
-                        self._max_depth, int(np.asarray(wave["max_depth"]).max())
+                        self._max_depth, int(self._pull(wave["max_depth"]).max())
                     )
                     if props:
-                        hit = np.asarray(wave["prop_hit"])
-                        phi = np.asarray(wave["prop_hi"])
-                        plo = np.asarray(wave["prop_lo"])
+                        hit = self._pull(wave["prop_hit"])
+                        phi = self._pull(wave["prop_hi"])
+                        plo = self._pull(wave["prop_lo"])
                         for i, p in enumerate(props):
                             if p.name in self._discoveries_fp:
                                 continue
@@ -936,7 +961,7 @@ class ShardedTpuBfsChecker(Checker):
                     if self._visitor is not None:
                         self._visit_chunk(chunk)
                 self._harvest(wave)
-                if not int(np.asarray(wave["overflow"]).sum()):
+                if not int(self._pull(wave["overflow"]).sum()):
                     break
                 table = self._grow_table(table, self._cap_loc * 2)
                 attempt += 1
@@ -966,7 +991,7 @@ class ShardedTpuBfsChecker(Checker):
                 # The host bound overcounts (F_loc per chunk regardless of
                 # occupancy); refresh it from the device before paying for
                 # a ring doubling and its retrace.
-                ring_est = int(np.asarray(count).max())
+                ring_est = int(self._pull(count).max())
                 if ring_est + self._F_loc > self._PCl:
                     pool, head, count = self._grow_rings(pool, head, count)
             chunk = self._pool_take(G)
@@ -1052,7 +1077,7 @@ class ShardedTpuBfsChecker(Checker):
                 "sharded_bfs.drain", step_num=drains
             ):
                 res = self._jit_deep_drain(*args)
-                dstats = np.asarray(res["drain_stats"])  # (n, 10)
+                dstats = self._pull(res["drain_stats"])  # (n, 10)
             self._state_count += int(dstats[:, 1].sum())
             self._unique_count += int(dstats[:, 2].sum())
             self._max_depth = max(self._max_depth, int(dstats[:, 3].max()))
@@ -1062,7 +1087,7 @@ class ShardedTpuBfsChecker(Checker):
             # sliced per device by its log_n.
             max_log = int(dstats[:, 0].max())
             if max_log:
-                pack = np.asarray(res["log_pack"][:, :, :max_log])
+                pack = self._pull(res["log_pack"][:, :, :max_log])
                 for d in range(n):
                     ln = int(dstats[d, 0])
                     if ln:
@@ -1093,9 +1118,9 @@ class ShardedTpuBfsChecker(Checker):
         self._state_count += int(dstats[:, 7].sum())
         self._max_depth = max(self._max_depth, int(dstats[:, 9].max()))
         if props:
-            hit = np.asarray(res["prop_hit"])
-            phi = np.asarray(res["prop_hi"])
-            plo = np.asarray(res["prop_lo"])
+            hit = self._pull(res["prop_hit"])
+            phi = self._pull(res["prop_hi"])
+            plo = self._pull(res["prop_lo"])
             for i, p in enumerate(props):
                 if p.name in self._discoveries_fp:
                     continue
@@ -1111,10 +1136,10 @@ class ShardedTpuBfsChecker(Checker):
         self._unique_count += total_new
         if total_new:
             B = self._F_loc * self._A
-            hi = np.asarray(final["new_hi"]).reshape(n, B)
-            lo = np.asarray(final["new_lo"]).reshape(n, B)
-            phi_ = np.asarray(final["parent_hi"]).reshape(n, B)
-            plo_ = np.asarray(final["parent_lo"]).reshape(n, B)
+            hi = self._pull(final["new_hi"]).reshape(n, B)
+            lo = self._pull(final["new_lo"]).reshape(n, B)
+            phi_ = self._pull(final["parent_hi"]).reshape(n, B)
+            plo_ = self._pull(final["parent_lo"]).reshape(n, B)
             sel = np.zeros((n, B), bool)
             for d in range(n):
                 sel[d, : int(n_new[d])] = True
@@ -1122,13 +1147,19 @@ class ShardedTpuBfsChecker(Checker):
                 (fp64_pairs(hi[sel], lo[sel]), fp64_pairs(phi_[sel], plo_[sel]))
             )
             if self._symmetry_enabled:
-                khi = np.asarray(final["new_khi"]).reshape(n, B)
-                klo = np.asarray(final["new_klo"]).reshape(n, B)
+                khi = self._pull(final["new_khi"]).reshape(n, B)
+                klo = self._pull(final["new_klo"]).reshape(n, B)
                 self._key_log.append(fp64_pairs(khi[sel], klo[sel]))
             # Push the exchanged rows into the rings (device-side; the
             # exchange already balanced them round-robin).
             recv_per_dev = final["recv_mask"].shape[0] // n
-            if ring_est + recv_per_dev > self._PCl:
+            # Grow until the received rows provably fit: recv_per_dev is
+            # n*ceil(B/n) and can exceed a single doubling of a small ring
+            # (ring_push would silently wrap and overwrite queued states).
+            while ring_est + recv_per_dev > self._PCl:
+                ring_est = int(self._pull(count).max())
+                if ring_est + recv_per_dev <= self._PCl:
+                    break
                 pool, head, count = self._grow_rings(pool, head, count)
             rows = dict(final["recv"])
             rows["mask"] = final["recv_mask"]
@@ -1153,7 +1184,7 @@ class ShardedTpuBfsChecker(Checker):
                 )
                 table = wave["table"]
                 self._harvest(wave)
-                if not int(np.asarray(wave["overflow"]).sum()):
+                if not int(self._pull(wave["overflow"]).sum()):
                     break
         return table, pool, head, count, ring_est
 
@@ -1161,12 +1192,12 @@ class ShardedTpuBfsChecker(Checker):
         """Deep-mode checkpoint: exports the rings into one host row-batch
         and saves it alongside any host-pool leftovers."""
         exported = self._jit_ring_export(pool, head, count)
-        mask = np.asarray(exported["mask"])
+        mask = self._pull(exported["mask"])
         batch = {
             k: (
-                jax.tree_util.tree_map(lambda x: np.asarray(x)[mask], v)
+                jax.tree_util.tree_map(lambda x: self._pull(x)[mask], v)
                 if k == "states"
-                else np.asarray(v)[mask]
+                else self._pull(v)[mask]
             )
             for k, v in exported.items()
             if k != "mask"
@@ -1210,12 +1241,12 @@ class ShardedTpuBfsChecker(Checker):
                     for a in (khi, klo, valid)
                 ),
             )
-            if not int(np.asarray(out["overflow"]).sum()):
+            if not int(self._pull(out["overflow"]).sum()):
                 break
             self._cap_loc *= 2
             table = self._new_table()
         table = out["table"]
-        fresh = np.asarray(out["fresh"])
+        fresh = self._pull(out["fresh"])
         self._state_count = int(valid.sum())
         self._unique_count = int(fresh.sum())
         child64 = fp64_pairs(hi, lo)
@@ -1272,7 +1303,10 @@ class ShardedTpuBfsChecker(Checker):
                 if self._key_log
                 else np.zeros((0,), np.uint64)
             )
-        atomic_pickle(path, payload)
+        # Multi-controller: every process builds the identical payload;
+        # exactly one writes the file.
+        if jax.process_index() == 0:
+            atomic_pickle(path, payload)
 
     def _restore(self, path):
         import pickle
@@ -1337,26 +1371,26 @@ class ShardedTpuBfsChecker(Checker):
                     ),
                 )
                 table = out["table"]
-                if not int(np.asarray(out["overflow"]).sum()):
+                if not int(self._pull(out["overflow"]).sum()):
                     break
                 table = self._grow_table(table, self._cap_loc * 2)
         return table
 
     def _harvest(self, wave):
         """Pulls each device's compacted fresh rows into the host pool."""
-        n_new = np.asarray(wave["n_new"])
+        n_new = self._pull(wave["n_new"])
         total = int(n_new.sum())
         self._unique_count += total
         if not total:
             return
         B = self._G * self._A // self._n
-        hi = np.asarray(wave["new_hi"])
-        lo = np.asarray(wave["new_lo"])
-        ebits = np.asarray(wave["new_ebits"])
-        depth = np.asarray(wave["new_depth"])
-        phi = np.asarray(wave["parent_hi"])
-        plo = np.asarray(wave["parent_lo"])
-        states = jax.tree_util.tree_map(np.asarray, wave["new_states"])
+        hi = self._pull(wave["new_hi"])
+        lo = self._pull(wave["new_lo"])
+        ebits = self._pull(wave["new_ebits"])
+        depth = self._pull(wave["new_depth"])
+        phi = self._pull(wave["parent_hi"])
+        plo = self._pull(wave["parent_lo"])
+        states = jax.tree_util.tree_map(self._pull, wave["new_states"])
         sel = np.zeros((self._n * B,), bool)
         for d in range(self._n):
             sel[d * B : d * B + int(n_new[d])] = True
@@ -1365,7 +1399,9 @@ class ShardedTpuBfsChecker(Checker):
         self._wave_log.append((child64[sel], par64[sel]))
         if self._symmetry_enabled:
             self._key_log.append(
-                fp64_pairs(wave["new_khi"], wave["new_klo"])[sel]
+                fp64_pairs(
+                    self._pull(wave["new_khi"]), self._pull(wave["new_klo"])
+                )[sel]
             )
         self._pool_append(
             {

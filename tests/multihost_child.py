@@ -1,0 +1,48 @@
+"""Child process for the multi-controller (multi-host) sharded test.
+
+Each of two processes owns 4 virtual CPU devices; ``jax.distributed``
+joins them into one 8-device mesh spanning both. The sharded checker then
+runs SPMD-over-hosts: both processes execute the same host loop, jit
+dispatches agree, and host pulls allgather (``ShardedTpuBfsChecker._pull``).
+
+Usage: ``python multihost_child.py <process_id> <coordinator_port>``.
+"""
+
+import os
+import sys
+
+pid = int(sys.argv[1])
+port = sys.argv[2]
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(
+    f"localhost:{port}", num_processes=2, process_id=pid
+)
+
+import numpy as np
+from jax.sharding import Mesh
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from stateright_tpu.models.two_phase_commit import TwoPhaseSys
+
+assert len(jax.devices()) == 8, jax.devices()
+assert len(jax.local_devices()) == 4
+
+mesh = Mesh(np.array(jax.devices()), ("fp",))
+checker = (
+    TwoPhaseSys(3)
+    .checker()
+    .spawn_sharded_tpu_bfs(
+        mesh=mesh, frontier_per_device=32, table_capacity_per_device=512
+    )
+    .join()
+)
+err = checker.worker_error()
+assert err is None, err
+assert checker.unique_state_count() == 288, checker.unique_state_count()
+checker.assert_properties()
+print(f"MULTIHOST-OK pid={pid} count=288", flush=True)

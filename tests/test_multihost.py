@@ -1,0 +1,57 @@
+"""Multi-controller execution: the sharded checker over a mesh spanning
+two PROCESSES (the local stand-in for multi-host TPU pods — same
+``jax.distributed`` path, DCN collectives replaced by Gloo over CPU).
+
+SURVEY §2.8 / PARITY "known gaps": the reference has no distributed
+checking at all; this validates ours end to end — cross-process
+``all_to_all``/``psum`` inside the deep drain, allgathered host pulls,
+and exact oracle counts on both controllers.
+"""
+
+import socket
+import subprocess
+import sys
+import os
+
+
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("localhost", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_two_process_mesh_exact_count():
+    port = _free_port()
+    child = os.path.join(os.path.dirname(__file__), "multihost_child.py")
+    # Children must NOT inherit this process's single-device pin or its
+    # force-host-device-count; they set their own.
+    env = {
+        k: v
+        for k, v in os.environ.items()
+        if k not in ("XLA_FLAGS", "JAX_PLATFORMS")
+    }
+    procs = [
+        subprocess.Popen(
+            [sys.executable, child, str(i), str(port)],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            env=env,
+        )
+        for i in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=390)
+            outs.append(out.decode())
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"process {i} failed:\n{out[-3000:]}"
+        assert f"MULTIHOST-OK pid={i} count=288" in out, out[-3000:]

@@ -155,3 +155,22 @@ def test_sharded_waves_mode_still_exact():
     )
     assert checker.worker_error() is None
     assert checker.unique_state_count() == 288
+
+
+def test_sharded_one_lane_frontier_grow_until_fits():
+    """frontier_per_device=1 makes the round-robin receive quota
+    (n*ceil(B/n)) comparable to the whole ring — the host push path must
+    grow until the received rows provably fit instead of wrapping."""
+    checker = (
+        TwoPhaseSys(3)
+        .checker()
+        .spawn_sharded_tpu_bfs(
+            frontier_per_device=1,
+            table_capacity_per_device=512,
+            pool_factor=1,
+            drain_log_factor=1,
+        )
+        .join()
+    )
+    assert checker.worker_error() is None
+    assert checker.unique_state_count() == 288
