@@ -6,6 +6,7 @@ on the reference workloads (2pc: 288 / 8,832) and on semantics fixtures
 (eventually bits, boundary, depth caps).
 """
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -253,3 +254,33 @@ def test_deep_drain_tiny_ring_and_log_exact():
     assert checker.worker_error() is None
     assert checker.unique_state_count() == 8832
     checker.assert_properties()
+
+
+def test_fingerprint_chunked_wide_words_path():
+    """The n > 64 (chunk-parallel) fingerprint branch: deterministic,
+    sensitive to every word position, and collision-free on random
+    wide-state word vectors."""
+    from stateright_tpu.ops.fingerprint import fingerprint_words
+
+    rng = np.random.default_rng(11)
+    words = jnp.asarray(
+        rng.integers(0, 1 << 32, size=100, dtype=np.uint64).astype(np.uint32)
+    )
+    fp = jax.jit(fingerprint_words)
+    base = tuple(int(x) for x in fp(words))
+    assert base == tuple(int(x) for x in fp(words))  # deterministic
+    for i in range(100):  # every position is live
+        flipped = words.at[i].set(words[i] ^ jnp.uint32(1))
+        assert tuple(int(x) for x in fp(flipped)) != base, i
+    # Length sensitivity (zero-padding must not alias n with n+1).
+    longer = jnp.concatenate([words, jnp.zeros((1,), jnp.uint32)])
+    assert tuple(int(x) for x in fp(longer)) != base
+    # Uniqueness over a batch of random wide vectors.
+    batch = jnp.asarray(
+        rng.integers(0, 1 << 32, size=(2000, 100), dtype=np.uint64).astype(
+            np.uint32
+        )
+    )
+    his, los = jax.jit(jax.vmap(fingerprint_words))(batch)
+    pairs = set(zip(np.asarray(his).tolist(), np.asarray(los).tolist()))
+    assert len(pairs) == 2000
