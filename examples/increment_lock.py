@@ -24,6 +24,10 @@ def main(argv=sys.argv):
             .symmetry()
             .spawn_dfs()
         )
+    elif cmd == "check-tpu":
+        n = opt_int(free, 0, 3)
+        print(f"Model checking increment_lock with {n} threads on TPU.")
+        report(IncrementLock(n).checker().spawn_tpu_bfs())
     elif cmd == "explore":
         n = opt_int(free, 0, 3)
         address = opt_str(free, 1, "localhost:3000")
@@ -33,6 +37,7 @@ def main(argv=sys.argv):
         print("USAGE:")
         print("  ./increment_lock.py check [THREAD_COUNT]")
         print("  ./increment_lock.py check-sym [THREAD_COUNT]")
+        print("  ./increment_lock.py check-tpu [THREAD_COUNT]")
         print("  ./increment_lock.py explore [THREAD_COUNT] [ADDRESS]")
 
 

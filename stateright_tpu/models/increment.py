@@ -14,6 +14,9 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import List, Tuple
 
+import numpy as np
+
+from ..core.batch import BatchableModel
 from ..core.model import Model, Property
 
 # ProcState is (t, pc): thread-local value and program counter.
@@ -28,8 +31,14 @@ class IncrementState:
         return IncrementState(i=self.i, s=tuple(sorted(self.s)))
 
 
-class Increment(Model):
-    """pc 1: may Read (t <- i, pc 2); pc 2: may Write (i <- t+1, pc 3)."""
+class Increment(Model, BatchableModel):
+    """pc 1: may Read (t <- i, pc 2); pc 2: may Write (i <- t+1, pc 3).
+
+    Packed layout (device path): ``i`` scalar u32, ``t``/``pc`` per-thread
+    (N,) u32 vectors. One dense action per thread (the pc uniquely selects
+    the enabled op, so the successor set matches the host model's separate
+    Read/Write actions exactly).
+    """
 
     def __init__(self, thread_count: int):
         self.thread_count = thread_count
@@ -63,6 +72,73 @@ class Increment(Model):
             )
         ]
 
+    # -- BatchableModel (packed protocol) ----------------------------------
+
+    def packed_action_count(self) -> int:
+        return self.thread_count
+
+    def packed_init_states(self):
+        import jax.numpy as jnp
+
+        n = self.thread_count
+        return {
+            "i": jnp.zeros((1,), jnp.uint32),
+            "t": jnp.zeros((1, n), jnp.uint32),
+            "pc": jnp.ones((1, n), jnp.uint32),
+        }
+
+    def packed_step(self, state, action_id):
+        import jax.numpy as jnp
+
+        tid = action_id.astype(jnp.int32)
+        i, t, pc = state["i"], state["t"], state["pc"]
+        pc_n = pc[tid]
+        is_read = pc_n == 1
+        valid = is_read | (pc_n == 2)
+        new = {
+            "i": jnp.where(
+                is_read, i, (t[tid] + jnp.uint32(1)) & jnp.uint32(0xFF)
+            ),
+            "t": t.at[tid].set(jnp.where(is_read, i, t[tid])),
+            "pc": pc.at[tid].set(pc_n + jnp.uint32(1)),
+        }
+        return new, valid
+
+    def packed_conditions(self):
+        import jax.numpy as jnp
+
+        return [
+            lambda st: (st["pc"] == 3).sum(dtype=jnp.uint32) == st["i"],
+        ]
+
+    def packed_symmetry(self):
+        from ..core.batch import permutation_tables
+
+        return permutation_tables(self.thread_count)
+
+    def packed_apply_permutation(self, state, new_to_old, old_to_new):
+        return {
+            "i": state["i"],
+            "t": state["t"][new_to_old],
+            "pc": state["pc"][new_to_old],
+        }
+
+    def pack_state(self, host_state: IncrementState):
+        return {
+            "i": np.uint32(host_state.i),
+            "t": np.array([t for t, _pc in host_state.s], np.uint32),
+            "pc": np.array([pc for _t, pc in host_state.s], np.uint32),
+        }
+
+    def unpack_state(self, packed) -> IncrementState:
+        return IncrementState(
+            i=int(packed["i"]),
+            s=tuple(
+                (int(t), int(pc))
+                for t, pc in zip(packed["t"], packed["pc"])
+            ),
+        )
+
 
 @dataclass(frozen=True)
 class IncrementLockState:
@@ -74,8 +150,12 @@ class IncrementLockState:
         return IncrementLockState(i=self.i, lock=self.lock, s=tuple(sorted(self.s)))
 
 
-class IncrementLock(Model):
-    """Same counter machine with a lock; both properties hold."""
+class IncrementLock(Model, BatchableModel):
+    """Same counter machine with a lock; both properties hold.
+
+    Packed layout: ``i``/``lock`` scalar u32, ``t``/``pc`` (N,) u32; one
+    dense action per thread (pc + lock uniquely select the enabled op).
+    """
 
     def __init__(self, thread_count: int):
         self.thread_count = thread_count
@@ -127,3 +207,86 @@ class IncrementLock(Model):
                 <= 1,
             ),
         ]
+
+    # -- BatchableModel (packed protocol) ----------------------------------
+
+    def packed_action_count(self) -> int:
+        return self.thread_count
+
+    def packed_init_states(self):
+        import jax.numpy as jnp
+
+        n = self.thread_count
+        return {
+            "i": jnp.zeros((1,), jnp.uint32),
+            "lock": jnp.zeros((1,), jnp.uint32),
+            "t": jnp.zeros((1, n), jnp.uint32),
+            "pc": jnp.zeros((1, n), jnp.uint32),
+        }
+
+    def packed_step(self, state, action_id):
+        import jax.numpy as jnp
+
+        tid = action_id.astype(jnp.int32)
+        i, lock = state["i"], state["lock"]
+        t, pc = state["t"], state["pc"]
+        pc_n = pc[tid]
+        unlocked = lock == 0
+        is_lock = (pc_n == 0) & unlocked
+        is_read = pc_n == 1
+        is_write = pc_n == 2
+        is_release = (pc_n == 3) & ~unlocked
+        valid = is_lock | is_read | is_write | is_release
+        new = {
+            "i": jnp.where(
+                is_write, (t[tid] + jnp.uint32(1)) & jnp.uint32(0xFF), i
+            ),
+            "lock": jnp.where(
+                is_lock, jnp.uint32(1), jnp.where(is_release, jnp.uint32(0), lock)
+            ),
+            "t": t.at[tid].set(jnp.where(is_read, i, t[tid])),
+            "pc": pc.at[tid].set(pc_n + jnp.uint32(1)),
+        }
+        return new, valid
+
+    def packed_conditions(self):
+        import jax.numpy as jnp
+
+        return [
+            lambda st: (st["pc"] >= 3).sum(dtype=jnp.uint32) == st["i"],
+            lambda st: ((st["pc"] >= 1) & (st["pc"] < 4)).sum(
+                dtype=jnp.int32
+            )
+            <= 1,
+        ]
+
+    def packed_symmetry(self):
+        from ..core.batch import permutation_tables
+
+        return permutation_tables(self.thread_count)
+
+    def packed_apply_permutation(self, state, new_to_old, old_to_new):
+        return {
+            "i": state["i"],
+            "lock": state["lock"],
+            "t": state["t"][new_to_old],
+            "pc": state["pc"][new_to_old],
+        }
+
+    def pack_state(self, host_state: IncrementLockState):
+        return {
+            "i": np.uint32(host_state.i),
+            "lock": np.uint32(1 if host_state.lock else 0),
+            "t": np.array([t for t, _pc in host_state.s], np.uint32),
+            "pc": np.array([pc for _t, pc in host_state.s], np.uint32),
+        }
+
+    def unpack_state(self, packed) -> IncrementLockState:
+        return IncrementLockState(
+            i=int(packed["i"]),
+            lock=bool(int(packed["lock"])),
+            s=tuple(
+                (int(t), int(pc))
+                for t, pc in zip(packed["t"], packed["pc"])
+            ),
+        )

@@ -174,3 +174,79 @@ class TestTimers:
         )
         assert checker.unique_state_count() > 10
         assert checker.max_depth() == 5
+
+
+class TestIncrementDevice:
+    """Device-path parity for the counter models (the last examples that
+    were host-only): full-traversal counts, symmetry orbit counts, and the
+    lost-update discovery all agree with the host checkers."""
+
+    @staticmethod
+    def _full(cls, n):
+        from stateright_tpu import Property
+        import jax.numpy as jnp
+
+        class Full(cls):
+            def properties(self):
+                return [Property.always("true", lambda _m, _s: True)]
+
+            def packed_conditions(self):
+                return [lambda st: jnp.bool_(True)]
+
+        return Full(n)
+
+    def test_increment_device_count_parity(self):
+        host = (
+            self._full(Increment, 3).checker().spawn_bfs().join()
+        )
+        dev = (
+            self._full(Increment, 3)
+            .checker()
+            .spawn_tpu_bfs(frontier_capacity=64, table_capacity=1 << 10)
+            .join()
+        )
+        assert dev.worker_error() is None
+        assert host.unique_state_count() == dev.unique_state_count()
+
+    def test_increment_device_symmetry_orbits(self):
+        host = (
+            self._full(Increment, 2)
+            .checker()
+            .symmetry()
+            .spawn_dfs()
+            .join()
+        )
+        dev = (
+            self._full(Increment, 2)
+            .checker()
+            .symmetry()
+            .spawn_tpu_bfs(frontier_capacity=32, table_capacity=1 << 9)
+            .join()
+        )
+        assert dev.worker_error() is None
+        assert host.unique_state_count() == dev.unique_state_count() == 8
+
+    def test_increment_device_finds_race_with_path(self):
+        dev = (
+            Increment(2)
+            .checker()
+            .spawn_tpu_bfs(frontier_capacity=32, table_capacity=1 << 9)
+            .join()
+        )
+        assert dev.worker_error() is None
+        path = dev.assert_any_discovery("fin")
+        assert len(path.into_actions()) >= 1
+
+    def test_increment_lock_device_holds_and_counts(self):
+        host = (
+            self._full(IncrementLock, 2).checker().spawn_bfs().join()
+        )
+        dev = (
+            IncrementLock(2)
+            .checker()
+            .spawn_tpu_bfs(frontier_capacity=32, table_capacity=1 << 10)
+            .join()
+        )
+        assert dev.worker_error() is None
+        dev.assert_properties()
+        assert dev.unique_state_count() == host.unique_state_count()
