@@ -11,15 +11,18 @@ same model, rate-sampled with a state-count cap so the bench stays fast;
 the reference itself publishes no absolute numbers (BASELINE.md).
 
 Secondary legs: paxos 2c/3s with the linearizability history checked on
-device per wave (reference flagship, ``examples/paxos.rs:325``), and the
-BASELINE.md 5-node lossy Raft at a depth cap.
+device per wave (reference flagship, ``examples/paxos.rs:325``), the
+BASELINE.md 5-node lossy Raft at a depth cap, and — on the accelerator
+only — the north-star ``paxos check 3`` config (1.19M states).
 
 Each leg runs in its OWN subprocess with its own timeout: the device
 tunnel on this image is flaky and can wedge any single run; a wedged leg
 must cost only its own timeout, not the whole bench. Legs that fail on
-the accelerator are retried CPU-pinned so the line always carries at
-least a fallback number. Diagnostics go to stderr; stdout carries only
-the JSON line.
+the accelerator are retried CPU-pinned so the primary line always
+carries at least a fallback number — EXCEPT the ``ACCEL_ONLY_LEGS``,
+which are skipped outright when no accelerator is reachable (their CPU
+compute cost exceeds any sensible fallback budget). Diagnostics go to
+stderr; stdout carries only the JSON line.
 """
 
 from __future__ import annotations
@@ -34,7 +37,11 @@ EXPECTED_UNIQUE = 296_448
 HOST_CAP = 30_000
 DEVICE_PROBE_TIMEOUT_S = 60
 DEVICE_PROBE_ATTEMPTS = 3
-LEG_TIMEOUT_S = {"2pc": 720, "paxos": 600, "raft5": 600}
+LEG_TIMEOUT_S = {"2pc": 720, "paxos": 600, "raft5": 600, "paxos3": 900}
+# Accelerator-only legs: far too slow for the CPU fallback (paxos-3c3s
+# takes ~15 min of pure compute there), so a tunnel failure skips them
+# instead of burning the fallback budget.
+ACCEL_ONLY_LEGS = {"paxos3"}
 
 
 def log(*args):
@@ -71,6 +78,66 @@ def _accelerator_usable() -> bool:
     return False
 
 
+def _leg_specs():
+    """One spec per leg: model factory, builder tweaks, spawn kwargs, and
+    the pinned oracle count. The shared skeleton in ``_run_leg`` does the
+    rest (optional host baseline, count assert, rate computation)."""
+    from stateright_tpu.models.paxos import PaxosModelCfg
+    from stateright_tpu.models.raft import RaftModelCfg
+    from stateright_tpu.models.two_phase_commit import TwoPhaseSys
+
+    return {
+        "2pc": dict(
+            model=lambda: TwoPhaseSys(RM_COUNT),
+            spawn=dict(
+                frontier_capacity=1 << 13,
+                table_capacity=1 << 20,
+                drain_log_factor=48,
+            ),
+            expected=EXPECTED_UNIQUE,
+            host_baseline=True,
+        ),
+        # Paxos BFS frontiers are narrow (hundreds of states); a small
+        # fixed wave width wastes far fewer masked lanes (measured 3.4x
+        # steady-state vs 2048 lanes on the CPU backend).
+        "paxos": dict(
+            model=lambda: PaxosModelCfg(2, 3).into_model(),
+            spawn=dict(frontier_capacity=1 << 9, table_capacity=1 << 16),
+            expected=16_668,
+        ),
+        # The north-star workload (BASELINE.md: `paxos check 3`): 3
+        # clients / 3 servers with the linearizability history checked on
+        # device per wave; the property HOLDS, so this is a full-space
+        # traversal. Count pinned from a full TpuBfsChecker (device-path)
+        # run executed on the CPU backend (862s).
+        "paxos3": dict(
+            model=lambda: PaxosModelCfg(3, 3, envelope_capacity=24).into_model(),
+            spawn=dict(
+                frontier_capacity=1 << 11,
+                table_capacity=1 << 21,
+                drain_log_factor=32,
+            ),
+            expected=1_194_428,
+        ),
+        # Depth cap (not a state-count target) keeps raft-5 deterministic
+        # AND deep-drain-eligible; 29,522 is the pinned depth-7 oracle
+        # (TpuBfsChecker on the CPU backend; the single-device deep drain
+        # is strict-FIFO so cap semantics are exact). Frontier kept modest:
+        # raft-5 packs ~1.3KB/state and expands 125 actions/lane. The
+        # "stable leader" liveness property is intentionally falsifiable,
+        # so properties are not asserted.
+        "raft5": dict(
+            model=lambda: RaftModelCfg(
+                server_count=5, max_term=1, lossy=True
+            ).into_model(),
+            builder=lambda b: b.target_max_depth(7),
+            spawn=dict(frontier_capacity=1 << 11, table_capacity=1 << 21),
+            expected=29_522,
+            check_properties=False,
+        ),
+    }
+
+
 def _run_leg(leg: str, pin_cpu: bool):
     """Child entry: runs one leg, prints its result dict as a JSON line."""
     import jax
@@ -83,12 +150,11 @@ def _run_leg(leg: str, pin_cpu: bool):
     log(f"[{leg}] device: {device.platform} ({device})")
     out = {"device": device.platform}
 
-    if leg == "2pc":
-        from stateright_tpu.models.two_phase_commit import TwoPhaseSys
-
+    spec = _leg_specs()[leg]
+    if spec.get("host_baseline"):
         t0 = time.time()
         host = (
-            TwoPhaseSys(RM_COUNT)
+            spec["model"]()
             .checker()
             .target_state_count(HOST_CAP)
             .spawn_bfs()
@@ -97,104 +163,32 @@ def _run_leg(leg: str, pin_cpu: bool):
         host_dt = time.time() - t0
         out["host_rate"] = host.unique_state_count() / host_dt
         log(
-            f"[2pc] host BfsChecker: {host.unique_state_count()} unique "
+            f"[{leg}] host BfsChecker: {host.unique_state_count()} unique "
             f"in {host_dt:.2f}s = {out['host_rate']:,.0f}/s (capped)"
         )
 
-        t0 = time.time()
-        checker = (
-            TwoPhaseSys(RM_COUNT)
-            .checker()
-            .spawn_tpu_bfs(
-                frontier_capacity=1 << 13,
-                table_capacity=1 << 20,
-                drain_log_factor=48,
-            )
-            .join()
+    t0 = time.time()
+    builder = spec["model"]().checker()
+    builder = spec.get("builder", lambda b: b)(builder)
+    checker = builder.spawn_tpu_bfs(**spec["spawn"]).join()
+    dt = time.time() - t0
+    err = checker.worker_error()
+    if err is not None:
+        raise err
+    expected = spec["expected"]
+    if checker.unique_state_count() != expected:
+        raise AssertionError(
+            f"{leg} count mismatch: "
+            f"{checker.unique_state_count()} != {expected}"
         )
-        dt = time.time() - t0
-        err = checker.worker_error()
-        if err is not None:
-            raise err
-        unique = checker.unique_state_count()
-        if unique != EXPECTED_UNIQUE:
-            raise AssertionError(
-                f"2pc-{RM_COUNT} count mismatch: {unique} != {EXPECTED_UNIQUE}"
-            )
+    if spec.get("check_properties", True):
         checker.assert_properties()
-        out.update(
-            unique=unique,
-            wall_s=dt,
-            warmup_s=checker.warmup_seconds or 0.0,
-            rate=unique / max(dt - (checker.warmup_seconds or 0.0), 1e-9),
-        )
-    elif leg == "paxos":
-        from stateright_tpu.models.paxos import PaxosModelCfg
-
-        # Paxos BFS frontiers are narrow (hundreds of states); a small
-        # fixed wave width wastes far fewer masked lanes (measured 3.4x
-        # steady-state vs 2048 lanes on the CPU backend).
-        t0 = time.time()
-        checker = (
-            PaxosModelCfg(2, 3)
-            .into_model()
-            .checker()
-            .spawn_tpu_bfs(frontier_capacity=1 << 9, table_capacity=1 << 16)
-            .join()
-        )
-        dt = time.time() - t0
-        err = checker.worker_error()
-        if err is not None:
-            raise err
-        if checker.unique_state_count() != 16_668:
-            raise AssertionError(
-                f"paxos-2c3s count mismatch: "
-                f"{checker.unique_state_count()} != 16668"
-            )
-        checker.assert_properties()
-        out.update(
-            unique=16_668,
-            wall_s=dt,
-            warmup_s=checker.warmup_seconds or 0.0,
-            rate=16_668 / max(dt - (checker.warmup_seconds or 0.0), 1e-9),
-        )
-    elif leg == "raft5":
-        from stateright_tpu.models.raft import RaftModelCfg
-
-        # Depth cap (not a state-count target) keeps the workload
-        # deterministic AND deep-drain-eligible; 29,522 is the pinned
-        # depth-7 oracle (measured on the CPU backend, single-device deep
-        # drain is strict-FIFO so the cap semantics are exact). Frontier
-        # kept modest: raft-5 packs ~1.3KB/state and expands 125
-        # actions/lane, so candidate buffers scale at ~0.3GB per 2048
-        # lanes.
-        t0 = time.time()
-        checker = (
-            RaftModelCfg(server_count=5, max_term=1, lossy=True)
-            .into_model()
-            .checker()
-            .target_max_depth(7)
-            .spawn_tpu_bfs(frontier_capacity=1 << 11, table_capacity=1 << 21)
-            .join()
-        )
-        dt = time.time() - t0
-        err = checker.worker_error()
-        if err is not None:
-            raise err
-        if checker.unique_state_count() != 29_522:
-            raise AssertionError(
-                f"raft5-depth7 count mismatch: "
-                f"{checker.unique_state_count()} != 29522"
-            )
-        out.update(
-            unique=29_522,
-            wall_s=dt,
-            warmup_s=checker.warmup_seconds or 0.0,
-            rate=29_522
-            / max(dt - (checker.warmup_seconds or 0.0), 1e-9),
-        )
-    else:
-        raise ValueError(f"unknown leg {leg!r}")
+    out.update(
+        unique=expected,
+        wall_s=dt,
+        warmup_s=checker.warmup_seconds or 0.0,
+        rate=expected / max(dt - (checker.warmup_seconds or 0.0), 1e-9),
+    )
     log(
         f"[{leg}] {out.get('unique')} unique in {out.get('wall_s'):.2f}s "
         f"wall ({out.get('warmup_s'):.2f}s warmup) = "
@@ -237,9 +231,12 @@ def main():
 
     on_accel = _accelerator_usable()
     results = {}
-    for leg in ("2pc", "paxos", "raft5"):
+    for leg in ("2pc", "paxos", "raft5", "paxos3"):
         res = _leg_subprocess(leg, pin_cpu=False) if on_accel else None
         if res is None:
+            if leg in ACCEL_ONLY_LEGS:
+                log(f"[{leg}] accelerator-only leg skipped")
+                continue
             log(f"[{leg}] falling back to CPU-pinned run")
             res = _leg_subprocess(leg, pin_cpu=True)
         if res is not None:
@@ -273,7 +270,7 @@ def main():
         "warmup_s": round(primary["warmup_s"], 2),
         "device": primary["device"],
     }
-    for leg in ("paxos", "raft5"):
+    for leg in ("paxos", "raft5", "paxos3"):
         if leg in results:
             line[f"{leg}_rate"] = round(results[leg]["rate"], 1)
             line[f"{leg}_unique"] = results[leg]["unique"]

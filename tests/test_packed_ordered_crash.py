@@ -9,6 +9,7 @@ hash-excludes-``crashed`` quirk (``src/actor/model_state.rs:86-97``) via
 """
 
 import numpy as np
+import pytest
 
 from stateright_tpu.actor import Network
 from stateright_tpu.models.linearizable_register import AbdModelCfg
@@ -75,3 +76,22 @@ def test_raft_crash_sharded_parity():
     )
     assert checker.worker_error() is None
     assert checker.unique_state_count() == 2252
+
+
+@pytest.mark.slow
+def test_ordered_abd_3_clients_bench_family_parity():
+    """The `linearizable-register check 3 ordered` bench-family config
+    (BASELINE.md measurement configs): 3 clients / 2 servers over ordered
+    FIFO flows, 46,516 states (host oracle measured once, pinned), with
+    the linearizability history holding on the device path."""
+    model = AbdModelCfg(
+        3, 2, network=Network.new_ordered(), envelope_capacity=12
+    ).into_model()
+    checker = (
+        model.checker()
+        .spawn_tpu_bfs(frontier_capacity=1 << 11, table_capacity=1 << 17)
+        .join()
+    )
+    assert checker.worker_error() is None
+    assert checker.unique_state_count() == 46_516
+    checker.assert_properties()
