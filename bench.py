@@ -150,7 +150,10 @@ def _run_leg(leg: str, pin_cpu: bool):
     log(f"[{leg}] device: {device.platform} ({device})")
     out = {"device": device.platform}
 
-    spec = _leg_specs()[leg]
+    specs = _leg_specs()
+    if leg not in specs:
+        raise ValueError(f"unknown leg {leg!r} (have: {sorted(specs)})")
+    spec = specs[leg]
     if spec.get("host_baseline"):
         t0 = time.time()
         host = (

@@ -3,20 +3,25 @@
 This is the TPU-native re-architecture of the reference's ``BfsChecker``
 (``/root/reference/src/checker/bfs.rs``). Where the reference runs N worker
 threads popping 1500-state blocks from a ``JobBroker`` and deduplicating
-through a concurrent ``DashMap``, this checker advances the search one
-*wave* at a time entirely on device:
+through a concurrent ``DashMap``, this checker runs the whole search
+inside one compiled device loop (the *deep drain*): a device-resident
+FIFO ring holds the pending frontier, and each iteration runs one wave
 
     frontier batch ──vmap(packed_step over F×A grid)──▶ candidates
       ──fingerprint (u32-pair murmur fold)──▶ keys
       ──sort-dedup within wave──▶ wave-unique keys
       ──scatter-claim insert into device hash set──▶ fresh mask
-      ──masked-cumsum compaction──▶ next frontier
+      ──masked-cumsum compaction──▶ ring push + next frontier dequeue
 
-Per-wave, the host receives only: scalar counters, per-property discovery
-fingerprints, and the (child fp, parent fp) pairs needed for TLC-style path
-reconstruction (Yu/Manolios/Lamport), which replays the *host* model along
-the fingerprint trail exactly like the reference
-(``/root/reference/src/checker/path.rs:20-97``).
+exiting to the host only when the parent-fp log fills, the visited table
+or ring needs growing, or an undiscovered property hit. At each exit the
+host receives: scalar counters, per-property discovery fingerprints, and
+the (child fp, parent fp) pairs needed for TLC-style path reconstruction
+(Yu/Manolios/Lamport), which replays the *host* model along the
+fingerprint trail exactly like the reference
+(``/root/reference/src/checker/path.rs:20-97``). Wave-at-a-time mode
+(``max_drain_waves=1``, or any visitor/target-count run) keeps the old
+per-wave host loop for callback and overshoot granularity.
 
 Semantics parity notes (all mirrored from the reference):
 - ``eventually`` bits propagate along paths and are NOT part of the
@@ -24,8 +29,9 @@ Semantics parity notes (all mirrored from the reference):
   cycles (``/root/reference/src/checker/bfs.rs:285-305``).
 - ``target_state_count``/``target_max_depth`` may overshoot by up to a wave
   (the reference overshoots by up to a block, ``src/checker.rs:234-236``).
-- Symmetry reduction is ignored, matching the reference's BFS (only its
-  DFS/simulation checkers apply symmetry).
+- Symmetry reduction (``.symmetry()``) EXCEEDS the reference's BFS (which
+  ignores it): visited keys become orbit-minimum fingerprints, re-avalanched
+  for home-slot uniformity (see ``_make_key_fn``).
 """
 
 from __future__ import annotations

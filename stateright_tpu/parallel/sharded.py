@@ -868,6 +868,11 @@ class ShardedTpuBfsChecker(Checker):
         return chunk
 
     def _explore(self):
+        self._t_start = time.perf_counter()
+        # Wall-clock burned before the first drain/wave could run —
+        # dominated by XLA compilation; benchmarks subtract it to report
+        # steady-state rates (parity with TpuBfsChecker.warmup_seconds).
+        self.warmup_seconds: Optional[float] = None
         self._pool = deque()
         self._pool_count = 0
         if self._resume_from is not None:
@@ -965,6 +970,8 @@ class ShardedTpuBfsChecker(Checker):
                     break
                 table = self._grow_table(table, self._cap_loc * 2)
                 attempt += 1
+            if self.warmup_seconds is None:
+                self.warmup_seconds = time.perf_counter() - self._t_start
             # Re-ingest fresh rows for the next chunks.
             del dev
 
@@ -1073,6 +1080,10 @@ class ShardedTpuBfsChecker(Checker):
                 # exploration) doesn't fold into any warmup measurement.
                 self._jit_deep_drain.lower(*args).compile()
                 compiled = True
+                if self.warmup_seconds is None:
+                    self.warmup_seconds = (
+                        time.perf_counter() - self._t_start
+                    )
             with jax.profiler.StepTraceAnnotation(
                 "sharded_bfs.drain", step_num=drains
             ):
