@@ -284,3 +284,23 @@ def test_fingerprint_chunked_wide_words_path():
     his, los = jax.jit(jax.vmap(fingerprint_words))(batch)
     pairs = set(zip(np.asarray(his).tolist(), np.asarray(los).tolist()))
     assert len(pairs) == 2000
+
+
+@pytest.mark.slow
+def test_deep_drain_2pc8_scale_exact():
+    """Scale regression net: 2pc-8 (1,745,408 states — measured once from
+    this checker and cross-validated by the sharded mesh) exercises table
+    growth, log-full drain exits, and multi-GB-candidate waves end to end."""
+    checker = (
+        TwoPhaseSys(8)
+        .checker()
+        .spawn_tpu_bfs(
+            frontier_capacity=1 << 13,
+            table_capacity=1 << 20,  # forces ~2 growth/rehash cycles
+            drain_log_factor=48,
+        )
+        .join()
+    )
+    assert checker.worker_error() is None
+    assert checker.unique_state_count() == 1_745_408
+    checker.assert_properties()
