@@ -1,0 +1,20 @@
+"""Import FIRST in any ad-hoc script meant to run on the CPU backend.
+
+This image's sitecustomize registers a tunneled ``axon`` TPU backend and
+forces ``jax_platforms=axon,cpu`` through ``jax.config`` — which OVERRIDES
+the ``JAX_PLATFORMS`` env var, so ``JAX_PLATFORMS=cpu python script.py``
+still dispatches (and hangs) through a wedged tunnel. Re-pinning must go
+through the config, after importing jax::
+
+    import scripts.cpu_pin  # noqa: F401  (must be the first import)
+
+Mirrors tests/conftest.py and bench.py's ``--cpu`` leg pinning.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

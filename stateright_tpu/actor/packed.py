@@ -197,10 +197,12 @@ class PackedActorModel(ActorModel, BatchableModel):
                 "this codec does not pack auxiliary history (declare "
                 "history_width and the history hooks to stage it on device)"
             )
-        if len(self._init_network.data):
-            raise NotImplementedError(
-                "non-empty initial networks are not packed yet"
-            )
+        # Non-empty initial networks need no special staging: host
+        # ``init_states`` seeds the ``ActorModelState`` network from
+        # ``init_network`` (reference ``src/actor/model.rs:96-100``) plus
+        # on-start sends, and ``pack_state`` packs whatever the state's
+        # network holds — envelope table and FIFO flows alike. Capacity
+        # overflow surfaces as the usual ``ValueError`` at packing time.
 
     # -- static shape helpers ----------------------------------------------
 
@@ -487,6 +489,103 @@ class PackedActorModel(ActorModel, BatchableModel):
             # No re-sort needed: the fingerprint view digests the envelope
             # table order-insensitively.
         return out
+
+    def packed_refine_colors(self, state, colors):
+        """Generic equivariant WL round for packed actor systems (see
+        ``core/batch.py``): each actor's new color hashes its own row (with
+        embedded ids replaced by their colors, reusing the codec's
+        ``rewrite_actor_row``/``rewrite_msg_ids`` relabeling hooks — which
+        must therefore be value-wise and shift-safe for arbitrary uint32
+        "names", not just true permutations), its timer bits, and
+        commutative digests of its incoming/outgoing envelopes tagged with
+        the peer's color. ``crashed`` is EXCLUDED, matching
+        ``packed_fingerprint_view`` — the dedup key the colors steer hashes
+        the view, so including crash flags could split view-equal states
+        into different canonical permutations."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..ops.fingerprint import avalanche32
+
+        codec = self.codec
+        n = self._N
+        u = jnp.uint32
+
+        def rows_under(c):
+            return jax.vmap(
+                lambda r: codec.rewrite_actor_row(self, r, c)
+            )(state["rows"])
+
+        rows_c = rows_under(colors)
+        acc = colors * u(0x9E3779B1) + u(0x7F4A7C15)
+        for j in range(rows_c.shape[1]):
+            acc = acc * u(0x01000193) ^ rows_c[:, j]
+        acc = avalanche32(acc * u(0x01000193) ^ state["timers"].astype(u))
+
+        # Reverse row-references: envelopes flow colors both ways below,
+        # but a row embedding actor j's id (votedFor, vote bitmaps, ...)
+        # informs only the REFERRER's color — actor j must also learn who
+        # references it or WL leaves non-automorphic actors tied (and
+        # every such tie pays the n! fallback). References are detected
+        # generically and exactly: rewrites gather by INDEX, so perturbing
+        # slot j's name changes exactly the rows that reference j.
+        rev = jnp.zeros((n,), u)
+        hcol = avalanche32(colors * u(0x27D4EB2F) + u(0x165667B1))
+        for j in range(n):
+            cj = colors.at[j].set(colors[j] ^ u(0x80000001))
+            refs = (rows_under(cj) != rows_c).any(axis=1)
+            rev = rev.at[j].set(
+                jnp.where(refs, hcol, u(0)).sum(dtype=u)
+            )
+        acc = avalanche32(acc ^ rev * u(0x9E3779B7))
+
+        if self._ordered:
+            P, Q = self._P, self._Q
+            fmsg_c = jax.vmap(
+                jax.vmap(lambda v: codec.rewrite_msg_ids(self, v, colors))
+            )(state["flow_msg"])
+            flen = state["flow_len"].astype(u)
+            live = jnp.arange(Q, dtype=u)[None, :] < flen[:, None]
+            h = jnp.full((P,), 0x811C9DC5, u)
+            for q in range(Q):
+                hq = h
+                for w in range(fmsg_c.shape[2]):
+                    hq = hq * u(0x01000193) ^ fmsg_c[:, q, w]
+                h = jnp.where(live[:, q], hq, h)
+            h = avalanche32(h ^ flen * u(0x9E3779B9))
+            a = jnp.arange(P, dtype=jnp.int32) // n
+            b = jnp.arange(P, dtype=jnp.int32) % n
+            out_c = avalanche32(h ^ colors[b] * u(0xCC9E2D51) + u(0x52DCE729))
+            in_c = avalanche32(h ^ colors[a] * u(0x1B873593) + u(0x38495AB5))
+            out_sum = out_c.reshape(n, n).sum(axis=1, dtype=u)
+            in_sum = in_c.reshape(n, n).sum(axis=0, dtype=u)
+        else:
+            msg_c = jax.vmap(
+                lambda v: codec.rewrite_msg_ids(self, v, colors)
+            )(state["net_msg"])
+            cnt = state["net_cnt"].astype(u)
+            occ = cnt > 0
+            h = jnp.full((cnt.shape[0],), 0x811C9DC5, u)
+            for w in range(msg_c.shape[1]):
+                h = h * u(0x01000193) ^ msg_c[:, w]
+            h = avalanche32(h ^ cnt * u(0x9E3779B9))
+            src = state["net_src"].astype(jnp.int32)
+            dst = state["net_dst"].astype(jnp.int32)
+            out_c = jnp.where(
+                occ,
+                avalanche32(h ^ colors[dst] * u(0xCC9E2D51) + u(0x52DCE729)),
+                u(0),
+            )
+            in_c = jnp.where(
+                occ,
+                avalanche32(h ^ colors[src] * u(0x1B873593) + u(0x38495AB5)),
+                u(0),
+            )
+            out_sum = jax.ops.segment_sum(out_c, src, num_segments=n)
+            in_sum = jax.ops.segment_sum(in_c, dst, num_segments=n)
+        return avalanche32(
+            acc ^ out_sum * u(0x85EBCA6B) ^ in_sum * u(0xC2B2AE35)
+        )
 
     def _net_send(self, state, src, dst, msg, active):
         """One network send (host ``Network.send``): duplicating nets dedup,
@@ -813,12 +912,15 @@ class PackedActorModel(ActorModel, BatchableModel):
     def packed_conditions(self):
         self._packed_check()
         conds = self.codec.packed_conditions(self)
-        if len(conds) != len(self._properties):
+        # Codecs emit one condition per property *as originally added*;
+        # ``retain_properties`` may have since narrowed the model, so select
+        # by the recorded append positions.
+        if len(conds) != self._properties_added:
             raise ValueError(
                 "codec.packed_conditions must align with the model's "
-                f"properties: {len(conds)} != {len(self._properties)}"
+                f"properties as added: {len(conds)} != {self._properties_added}"
             )
-        return conds
+        return [conds[i] for i in self._property_codec_pos]
 
     def packed_within_boundary(self, state):
         return self.codec.packed_within_boundary(self, state)

@@ -84,15 +84,20 @@ def packed_model_digest(model, action_count: int) -> str:
 
 
 def checkpoint_header(
-    kind: str, model, action_count: int, symmetry: bool
+    kind: str, model, action_count: int, symmetry: bool, sym_scheme=None
 ) -> dict:
-    """Common checkpoint header shared by every device checker."""
+    """Common checkpoint header shared by every device checker.
+    ``sym_scheme`` is the visited-key scheme tag (``sym_key_scheme``);
+    legacy callers passing only the bool get the group scheme."""
+    if symmetry and sym_scheme is None:
+        sym_scheme = SYM_KEY_SCHEME
     return {
         "version": 1,
         "kind": kind,
         "model": type(model).__name__,
         "model_digest": packed_model_digest(model, action_count),
         "symmetry": symmetry,
+        "sym_scheme": sym_scheme if symmetry else None,
         "fp_scheme": FP_SCHEME,
     }
 
@@ -104,6 +109,7 @@ def validate_checkpoint_header(
     model,
     action_count: int,
     symmetry: bool,
+    sym_scheme=None,
 ) -> None:
     """Rejects checkpoints another checker kind, model, model configuration,
     or symmetry setting wrote. Checkpoints predating the ``kind`` field were
@@ -130,9 +136,18 @@ def validate_checkpoint_header(
     if payload.get("symmetry", False) != symmetry:
         raise ValueError(
             "checkpoint symmetry setting does not match this checker "
-            "(visited keys are orbit-minimum fingerprints under symmetry, "
+            "(visited keys are canonical-form fingerprints under symmetry, "
             "plain fingerprints otherwise; the two key spaces cannot mix)"
         )
+    if symmetry:
+        want = sym_scheme if sym_scheme is not None else SYM_KEY_SCHEME
+        if payload.get("sym_scheme") != want:
+            raise ValueError(
+                f"checkpoint symmetry-key scheme "
+                f"{payload.get('sym_scheme')!r} does not match this "
+                f"checker ({want!r}); its visited keys cannot be mixed "
+                "into a resumed run"
+            )
     if payload.get("fp_scheme") != FP_SCHEME:
         raise ValueError(
             f"checkpoint fingerprint scheme {payload.get('fp_scheme')!r} "
@@ -153,24 +168,91 @@ def atomic_pickle(path, payload) -> None:
     os.replace(tmp, path)
 
 
+# Symmetry visited-key scheme. r2 keyed on the n!-loop orbit-minimum
+# fingerprint; r3 keys verified lanes on the canonical-permutation
+# fingerprint (WL refinement) with per-lane orbit-minimum fallback — a
+# different (still orbit-proper) key space, so symmetry checkpoints
+# record and validate this tag.
+SYM_KEY_SCHEME = "wl-canon+orbitmin-v2"
+# Custom ``symmetry_fn`` runs key on fp(model.packed_representative(s)) —
+# a third key space, tagged separately in checkpoints.
+CUSTOM_REP_SCHEME = "custom-representative-v1"
+
+
+def sym_key_scheme(symmetry) -> "Optional[str]":
+    """The visited-key scheme tag a symmetry setting implies (None when
+    symmetry is off) — recorded in checkpoints so runs never resume across
+    incompatible key spaces."""
+    if symmetry is None:
+        return None
+    from .builder import default_representative
+
+    return (
+        SYM_KEY_SCHEME
+        if symmetry is default_representative
+        else CUSTOM_REP_SCHEME
+    )
+
+
 def _make_key_fn(model, fp_fn, symmetry):
     """Batched dedup-key function for the device checkers, or ``None`` when
     symmetry is off (callers then use the plain fingerprints they already
-    computed). Under symmetry the key is the orbit-minimum fingerprint,
-    computed as a sequential ``fori_loop`` over the ``n!`` permutations with
-    a lane-vectorized fingerprint pass per iteration — vmapping the group
-    axis instead would materialize ``B x n!`` permuted states at once."""
+    computed).
+
+    Under symmetry the key is a canonical-form fingerprint — a true orbit
+    invariant, so dedup merges states iff they share an orbit. Two routes
+    compute it:
+
+    - **Refined (fast)**: when the model implements
+      ``packed_refine_colors`` (see ``core/batch.py``), iterate the
+      WL-style equivariant color refinement, sort actors by final color
+      (candidate canonical permutation), and VERIFY remaining color ties
+      are automorphisms by checking each adjacent tied transposition
+      leaves the fingerprint unchanged (adjacent transpositions generate
+      each tie class's full symmetric group). Verified lanes key on the
+      canonical-permutation fingerprint: ~``n`` fingerprint passes per
+      state.
+    - **Orbit-minimum (exact fallback)**: a sequential ``fori_loop`` over
+      all ``n!`` permutations taking the minimum fingerprint — vmapping
+      the group axis instead would materialize ``B x n!`` permuted states
+      at once. Used for the whole batch when the model has no refine
+      hook, and selected per-lane (via ``lax.cond``, so the loop only
+      executes on waves that need it) for lanes whose verification
+      failed.
+
+    The mix is consistent across waves: verification outcomes are orbit
+    invariants (computed on the canonical state), so every member of an
+    orbit takes the same route and thus the same key.
+    """
     if symmetry is None:
         return None
     from .builder import default_representative
 
     if symmetry is not default_representative:
-        raise ValueError(
-            "device checkers cannot honor a custom symmetry_fn: they reduce "
-            "by the full actor-permutation group (orbit-minimum fingerprint "
-            "keys), which would over-merge states under a partial symmetry. "
-            "Use .symmetry(), or a host checker for custom equivalences."
+        from ..core.batch import BatchableModel
+
+        has_rep = (
+            type(model).packed_representative
+            is not BatchableModel.packed_representative
         )
+        if not has_rep:
+            raise ValueError(
+                "device checkers cannot honor a custom symmetry_fn unless "
+                "the model implements packed_representative(): the built-in "
+                "keys reduce by the FULL actor-permutation group, which "
+                "would over-merge states under a partial symmetry. "
+                "Implement packed_representative (core/batch.py), use "
+                ".symmetry(), or a host checker."
+            )
+
+        def rep_keys(states_batch):
+            # Plain fingerprints of the user's canonical form — they
+            # inherit fingerprint_words' sentinel nudges, so no finalize.
+            return jax.vmap(
+                lambda s: fp_fn(model.packed_representative(s))
+            )(states_batch)
+
+        return rep_keys
     try:
         n2o, o2n = model.packed_symmetry()
     except (AttributeError, NotImplementedError) as e:
@@ -181,9 +263,9 @@ def _make_key_fn(model, fp_fn, symmetry):
         ) from e
     n2o = jnp.asarray(n2o)
     o2n = jnp.asarray(o2n)
-    n_perms = n2o.shape[0]
+    n_perms, n = n2o.shape
 
-    def orbit_keys(states_batch):
+    def full_min(states_batch):
         leaves = jax.tree_util.tree_leaves(states_batch)
         b = leaves[0].shape[0]
 
@@ -198,13 +280,17 @@ def _make_key_fn(model, fp_fn, symmetry):
             return jnp.where(better, his, mhi), jnp.where(better, los, mlo)
 
         full = jnp.full((b,), _U32_MAX)
-        khi, klo = jax.lax.fori_loop(0, n_perms, body, (full, full))
-        # Re-avalanche the minima: a min over |G| uniform draws concentrates
-        # in the low 1/|G| of the key space, which would pile every home
-        # slot (top bits of hi — ops/hashset._home) into the first
-        # capacity/|G| rows. The murmur finalizer is a bijection on u32, so
-        # scrambling each word introduces no new collisions; sentinels are
-        # nudged exactly like ops/fingerprint.fingerprint_words.
+        return jax.lax.fori_loop(0, n_perms, body, (full, full))
+
+    def finalize(khi, klo):
+        # Re-avalanche the keys: an orbit minimum over |G| uniform draws
+        # concentrates in the low 1/|G| of the key space, which would pile
+        # every home slot (top bits of hi — ops/hashset._home) into the
+        # first capacity/|G| rows. The murmur finalizer is a bijection on
+        # u32, so scrambling each word introduces no new collisions;
+        # sentinels are nudged exactly like ops/fingerprint
+        # .fingerprint_words. Canonical-permutation keys share the
+        # finalizer so both routes draw from one key space.
         khi = avalanche32(khi ^ jnp.uint32(0x51A7CC9E))
         klo = avalanche32(klo ^ jnp.uint32(0xE3779B97))
         zero = (khi == 0) & (klo == 0)
@@ -213,7 +299,59 @@ def _make_key_fn(model, fp_fn, symmetry):
         klo = jnp.where(maxed, jnp.uint32(_U32_MAX - 1), klo)
         return khi, klo
 
-    return orbit_keys
+    from ..core.batch import BatchableModel
+
+    has_refine = (
+        type(model).packed_refine_colors
+        is not BatchableModel.packed_refine_colors
+    )
+    if not has_refine:
+        def orbit_keys(states_batch):
+            return finalize(*full_min(states_batch))
+
+        return orbit_keys
+
+    # WL color partitions on n actors stabilize within n-1 rounds; extra
+    # rounds only re-hash a stable partition.
+    rounds = max(1, min(n - 1, 6))
+    iota = jnp.arange(n, dtype=jnp.int32)
+    # Static adjacent-transposition index vectors (swap positions i, i+1).
+    swaps = []
+    for i in range(n - 1):
+        sw = list(range(n))
+        sw[i], sw[i + 1] = sw[i + 1], sw[i]
+        swaps.append(jnp.asarray(sw, jnp.int32))
+
+    def refined_keys(states_batch):
+        def one(s):
+            colors = jnp.zeros((n,), jnp.uint32)
+            for _ in range(rounds):
+                colors = model.packed_refine_colors(s, colors)
+            sorted_colors, cand = jax.lax.sort(
+                (colors, iota), num_keys=1
+            )
+            inv = jnp.zeros((n,), jnp.int32).at[cand].set(iota)
+            hi0, lo0 = fp_fn(model.packed_apply_permutation(s, cand, inv))
+            ok = jnp.bool_(True)
+            for i in range(n - 1):
+                tie = sorted_colors[i] == sorted_colors[i + 1]
+                cand_i = cand[swaps[i]]
+                inv_i = jnp.zeros((n,), jnp.int32).at[cand_i].set(iota)
+                hi_i, lo_i = fp_fn(
+                    model.packed_apply_permutation(s, cand_i, inv_i)
+                )
+                ok = ok & (~tie | ((hi_i == hi0) & (lo_i == lo0)))
+            return hi0, lo0, ok
+
+        khi, klo, ok = jax.vmap(one)(states_batch)
+        fhi, flo = jax.lax.cond(
+            ok.all(), lambda: (khi, klo), lambda: full_min(states_batch)
+        )
+        return finalize(
+            jnp.where(ok, khi, fhi), jnp.where(ok, klo, flo)
+        )
+
+    return refined_keys
 
 
 def _pow2ceil(n: int) -> int:
@@ -241,6 +379,7 @@ class TpuBfsChecker(Checker):
         max_drain_waves=100_000,
         drain_log_factor=8,
         pool_factor=16,
+        hashset_impl="xla",
     ):
         model = options.model
         if not isinstance(model, BatchableModel):
@@ -271,6 +410,25 @@ class TpuBfsChecker(Checker):
         # through the device tunnel costs tens of seconds per shape.
         self._F_max = _pow2ceil(frontier_capacity)
         self._capacity = table_capacity
+        # Visited-set insert kernel for the sorted wave batches: "xla"
+        # (gather/scatter probing, ops/hashset.py) or "pallas" (tile-sweep
+        # DMA kernel, ops/pallas_hashset.py — measure both with
+        # ``python -m stateright_tpu.ops.bench_hashset`` and pick the
+        # winner per backend). The unsorted sites (_rehash, checkpoint
+        # restore) always use the XLA path.
+        if hashset_impl not in ("xla", "pallas"):
+            raise ValueError(
+                f"hashset_impl must be 'xla' or 'pallas', got {hashset_impl!r}"
+            )
+        if hashset_impl == "pallas":
+            from ..ops.pallas_hashset import TILE_ROWS
+
+            if table_capacity % TILE_ROWS:
+                raise ValueError(
+                    f"hashset_impl='pallas' needs table_capacity to be a "
+                    f"multiple of {TILE_ROWS} (got {table_capacity})"
+                )
+        self._hashset_impl = hashset_impl
         self._visitor = options._visitor
         self._target_state_count: Optional[int] = options._target_state_count
         self._depth_cap = options._target_max_depth or _DEPTH_INF
@@ -340,8 +498,10 @@ class TpuBfsChecker(Checker):
         # orbit-proper canonical key; see core/batch.py for why the
         # reference's sort heuristic cannot be used on a wave BFS).
         self._symmetry_enabled = options._symmetry is not None
+        self._sym_scheme = sym_key_scheme(options._symmetry)
         self._key_fn = _make_key_fn(model, self._fp_fn, options._symmetry)
         self._jit_wave = jax.jit(self._wave)
+        self._wave_exec = {}  # table capacity -> AOT-compiled wave
         self._jit_drain = jax.jit(self._deep_drain)
         self._jit_pool_zero = jax.jit(self._pool_zero, static_argnums=(0,))
         self._jit_pool_push = jax.jit(self._pool_push)
@@ -358,6 +518,20 @@ class TpuBfsChecker(Checker):
         self._handles[0].start()
 
     # -- device functions (jitted) ----------------------------------------
+
+    def _insert_sorted(self, table, shi, slo, active):
+        """Visited-set insert for a wave batch (keys sorted ascending —
+        both impls rely on it: XLA for first-claim-wins tie order, Pallas
+        for its single left-to-right table sweep). Off-TPU the Pallas
+        kernel runs in interpret mode: exact semantics, testing speed only."""
+        if self._hashset_impl == "pallas":
+            from ..ops.pallas_hashset import pallas_hashset_insert
+
+            return pallas_hashset_insert(
+                table, shi, slo, active,
+                interpret=jax.default_backend() != "tpu",
+            )
+        return hashset_insert(table, shi, slo, active)
 
     def _init_wave(self, table):
         states = self._model.packed_init_states()
@@ -377,7 +551,9 @@ class TpuBfsChecker(Checker):
             [jnp.ones((1,), bool), (shi[1:] != shi[:-1]) | (slo[1:] != slo[:-1])]
         )
         wave_unique = valid[sidx] & uniq
-        table, fresh, _found, pending = hashset_insert(table, shi, slo, wave_unique)
+        table, fresh, _found, pending = self._insert_sorted(
+            table, shi, slo, wave_unique
+        )
         return {
             "table": table,
             "states": states,
@@ -442,7 +618,9 @@ class TpuBfsChecker(Checker):
         wave_unique = cvalid_flat[sidx] & uniq
 
         # Claim slots in the visited set; fresh lanes form the next frontier.
-        table, fresh, _found, pending = hashset_insert(table, shi, slo, wave_unique)
+        table, fresh, _found, pending = self._insert_sorted(
+            table, shi, slo, wave_unique
+        )
         overflow = pending.sum()
         n_new = fresh.sum()
 
@@ -785,6 +963,34 @@ class TpuBfsChecker(Checker):
         else:
             self._explore_waves(table, queue, depth_cap, t_start)
 
+    def _call_wave(self, table, chunk, depth_cap):
+        """Runs one wave through an AOT-compiled executable (keyed by table
+        capacity — the only shape that varies at runtime). Explicit AOT
+        keeps warmup accounting exact: a compile triggered mid-run (table
+        growth changes the shape) is measured and moved into
+        ``warmup_seconds`` instead of polluting the steady-state window.
+        During the initial pre-first-result window ``warmup_seconds`` is
+        still None and the caller's own stamp covers the compile."""
+        args = (
+            table,
+            chunk["states"],
+            chunk["hi"],
+            chunk["lo"],
+            chunk["ebits"],
+            chunk["depth"],
+            chunk["mask"],
+            jnp.asarray(depth_cap, jnp.int32),
+        )
+        key = (table.shape[0], chunk["hi"].shape[0])
+        exe = self._wave_exec.get(key)
+        if exe is None:
+            t0 = time.perf_counter()
+            exe = self._jit_wave.lower(*args).compile()
+            self._wave_exec[key] = exe
+            if self.warmup_seconds is not None:
+                self.warmup_seconds += time.perf_counter() - t0
+        return exe(*args)
+
     def _consume_wave(self, table, wave, chunk, queue, depth_cap):
         """Applies one wave output host-side (counters, discoveries, log,
         requeue), retrying the producing frontier after table growth until
@@ -794,16 +1000,7 @@ class TpuBfsChecker(Checker):
         attempt = 0
         while True:
             if wave is None:
-                wave = self._jit_wave(
-                    table,
-                    chunk["states"],
-                    chunk["hi"],
-                    chunk["lo"],
-                    chunk["ebits"],
-                    chunk["depth"],
-                    chunk["mask"],
-                    depth_cap,
-                )
+                wave = self._call_wave(table, chunk, depth_cap)
             table = wave["table"]
             # Single host transfer per wave: [generated, n_new, overflow,
             # max_depth, any_prop_hit?]; per-property fingerprints are
@@ -1057,7 +1254,11 @@ class TpuBfsChecker(Checker):
         children, parents = self._store.export()
         payload = {
             **checkpoint_header(
-                "tpu_bfs", self._model, self._A, self._symmetry_enabled
+                "tpu_bfs",
+                self._model,
+                self._A,
+                self._symmetry_enabled,
+                self._sym_scheme,
             ),
             "state_count": self._state_count,
             "unique_count": self._unique_count,
@@ -1091,6 +1292,7 @@ class TpuBfsChecker(Checker):
             self._model,
             self._A,
             self._symmetry_enabled,
+            self._sym_scheme,
         )
         self._state_count = payload["state_count"]
         self._unique_count = payload["unique_count"]

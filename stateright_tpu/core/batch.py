@@ -141,6 +141,47 @@ class BatchableModel:
         so slot order never reaches the key."""
         raise NotImplementedError
 
+    def packed_representative(self, state: PackedState) -> PackedState:
+        """Optional traceable CUSTOM canonical form (the device analog of
+        the reference's user-defined ``Representative``,
+        ``src/checker/representative.rs:65-68``). When a checker is built
+        with ``.symmetry_fn(custom)``, the device dedup key is the
+        fingerprint of this state — the user guarantees it canonicalizes
+        exactly the equivalence their host ``symmetry_fn`` quotients by
+        (same-partition, like any Representative: unsound forms over- or
+        under-merge and the host/device parity tests will diverge). The
+        full-group ``.symmetry()`` path never calls this — it uses the
+        orbit-proper WL/orbit-minimum keys."""
+        raise NotImplementedError
+
+    def packed_refine_colors(
+        self, state: PackedState, colors: jax.Array
+    ) -> jax.Array:
+        """One round of equivariant per-actor color refinement (optional —
+        the Weisfeiler-Leman-style fast path for device symmetry keys).
+
+        Takes the (n,) uint32 color vector of the previous round (all-zero
+        initially) and returns a refined (n,) uint32 vector where each
+        actor's new color is a hash of its OWN id-free data plus the colors
+        of the actors it references (votes, leader hints, envelope
+        endpoints, …). The checkers iterate this to a stable partition,
+        sort actors by final color to obtain a candidate canonical
+        permutation, and verify remaining ties are genuine automorphisms —
+        falling back to the exact ``n!`` orbit-minimum for any state where
+        verification fails. Cost: ~``n`` fingerprint passes per state
+        instead of ``n!``.
+
+        MUST be equivariant: for any actor permutation ``s`` with action
+        ``sigma``, ``refine(sigma(state), sigma(colors)) ==
+        sigma(refine(state, colors))`` — i.e. depend on actor indices only
+        through gathered values, never on absolute positions. A
+        non-equivariant hook silently splits orbits (counts over-report);
+        the orbit-count parity tests are the guard. Verification-or-
+        fallback covers the other failure direction (under-separation)
+        exactly, so a WEAK hook only costs speed, never correctness.
+        """
+        raise NotImplementedError
+
     # -- host interop ------------------------------------------------------
 
     def pack_state(self, host_state: Any) -> PackedState:

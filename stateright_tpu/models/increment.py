@@ -123,6 +123,20 @@ class Increment(Model, BatchableModel):
             "pc": state["pc"][new_to_old],
         }
 
+    def packed_refine_colors(self, state, colors):
+        """Per-thread data is fully local (no cross-thread references), so
+        one equivariant round separates all non-automorphic threads."""
+        import jax.numpy as jnp
+
+        from ..ops.fingerprint import avalanche32
+
+        u = jnp.uint32
+        return avalanche32(
+            colors * u(0x9E3779B1)
+            ^ state["t"] * u(0x01000193)
+            ^ state["pc"] * u(0xCC9E2D51)
+        )
+
     def pack_state(self, host_state: IncrementState):
         return {
             "i": np.uint32(host_state.i),
@@ -272,6 +286,20 @@ class IncrementLock(Model, BatchableModel):
             "t": state["t"][new_to_old],
             "pc": state["pc"][new_to_old],
         }
+
+    def packed_refine_colors(self, state, colors):
+        """Per-thread data is fully local (the lock holder is implied by
+        ``pc``, not an id), so one equivariant round suffices."""
+        import jax.numpy as jnp
+
+        from ..ops.fingerprint import avalanche32
+
+        u = jnp.uint32
+        return avalanche32(
+            colors * u(0x9E3779B1)
+            ^ state["t"] * u(0x01000193)
+            ^ state["pc"] * u(0xCC9E2D51)
+        )
 
     def pack_state(self, host_state: IncrementLockState):
         return {

@@ -355,7 +355,12 @@ class RaftPackedCodec(ActorPackedCodec):
     def rewrite_actor_row(self, model, row, old_to_new):
         """``voted_for`` (stored +1, 0 = None) maps through the permutation;
         the ``votes`` bitmask moves bit ``b`` to bit ``old_to_new[b]``.
-        Messages carry no ids (the envelope src is the vote's identity)."""
+        Messages carry no ids (the envelope src is the vote's identity).
+        The shift is masked to stay defined when ``old_to_new`` carries WL
+        refinement colors (arbitrary uint32 names) instead of a true
+        permutation — a no-op for real permutations (ids < n <= 32), and
+        under colors the bitmask becomes a commutative digest of the
+        voters' (masked) colors, which is all refinement needs."""
         import jax.numpy as jnp
 
         o2n = old_to_new.astype(jnp.uint32)
@@ -363,7 +368,7 @@ class RaftPackedCodec(ActorPackedCodec):
         safe = jnp.where(voted == 0, jnp.uint32(0), voted - 1)
         new_voted = jnp.where(voted == 0, voted, o2n[safe] + 1)
         bits = (row[3] >> jnp.arange(self.n, dtype=jnp.uint32)) & jnp.uint32(1)
-        new_votes = (bits << o2n).sum(dtype=jnp.uint32)
+        new_votes = (bits << (o2n & jnp.uint32(31))).sum(dtype=jnp.uint32)
         return row.at[2].set(new_voted).at[3].set(new_votes)
 
     # -- traceable model hooks ---------------------------------------------

@@ -284,6 +284,28 @@ class TwoPhaseSys(Model, BatchableModel):
             | (state["msgs"] & ~low_mask),
         }
 
+    def packed_refine_colors(self, state, colors):
+        """Equivariant WL round (see ``core/batch.py``): each RM's view is
+        fully local — its code plus its ``prepared`` and ``Prepared{rm}``
+        bits — so one round separates every non-automorphic pair and color
+        ties are always genuine automorphisms (swapping two RMs with equal
+        triples fixes the state exactly). The global TM fields are
+        permutation-invariant and add nothing."""
+        import jax.numpy as jnp
+
+        from ..ops.fingerprint import avalanche32
+
+        u = jnp.uint32
+        idx = jnp.arange(self.rm_count, dtype=u)
+        prep = (state["prepared"] >> idx) & u(1)
+        msg = (state["msgs"] >> idx) & u(1)
+        return avalanche32(
+            colors * u(0x9E3779B1)
+            ^ state["rm"] * u(0x01000193)
+            ^ prep * u(0xCC9E2D51)
+            ^ msg * u(0x1B873593)
+        )
+
     def pack_state(self, host_state: TwoPhaseState):
         n = self.rm_count
         msgs = 0

@@ -4,8 +4,9 @@ on the current default device. Usage::
     python -m stateright_tpu.ops.bench_hashset [log2_capacity] [batch]
 
 Feeds both paths identical sorted batches at the checkers' target load
-factor and prints keys/sec for each. Decides whether the TPU checkers
-should flip ``hashset_impl`` to Pallas (see ``checker/tpu.py``).
+factor and prints keys/sec for each. Decides whether runs should pass
+``hashset_impl="pallas"`` to the TPU checkers (``checker/tpu.py`` — the
+default stays "xla" until the Pallas path measures faster on hardware).
 """
 
 from __future__ import annotations
@@ -60,15 +61,21 @@ def main():
         jax.block_until_ready(out[0])
         table = hashset_new(cap)
         t0 = time.perf_counter()
-        inserted = 0
+        lanes = 0
+        fresh_total = jnp.zeros((), jnp.int32)
+        pend_total = jnp.zeros((), jnp.int32)
         for h, l in data:
             table, fresh, _found, pend = fn(table, h, l)
-            inserted += batch
+            lanes += batch
+            fresh_total = fresh_total + fresh.sum(dtype=jnp.int32)
+            pend_total = pend_total + pend.sum(dtype=jnp.int32)
         jax.block_until_ready(table)
         dt = time.perf_counter() - t0
+        fresh_n = int(fresh_total)
         print(
-            f"{name}: {inserted} keys in {dt:.3f}s = {inserted/dt:,.0f}/s "
-            f"(pending={int(np.asarray(pend).sum())})"
+            f"{name}: {lanes} lanes in {dt:.3f}s = {lanes/dt:,.0f} lanes/s, "
+            f"{fresh_n/dt:,.0f} effective inserts/s "
+            f"(fresh={fresh_n} pending={int(pend_total)})"
         )
 
 

@@ -60,6 +60,7 @@ from ..checker.tpu import (
     _make_key_fn,
     atomic_pickle,
     checkpoint_header,
+    sym_key_scheme,
     validate_checkpoint_header,
 )
 
@@ -249,6 +250,7 @@ class ShardedTpuBfsChecker(Checker):
         # Visited/routing keys: orbit-minimum fingerprints under symmetry
         # reduction (see checker/tpu.py and core/batch.py).
         self._symmetry_enabled = options._symmetry is not None
+        self._sym_scheme = sym_key_scheme(options._symmetry)
         self._key_fn = _make_key_fn(model, self._fp_fn, options._symmetry)
         self._jit_fp_batch = jax.jit(jax.vmap(self._fp_fn))
         self._jit_key_batch = (
@@ -884,7 +886,13 @@ class ShardedTpuBfsChecker(Checker):
         # ring scheduling is only approximately global-FIFO across devices,
         # so a depth-capped run could first reach a state via a longer path
         # and prune expansions a strict BFS would keep. (Without a cap the
-        # visited SET is order-independent — counts stay exact.)
+        # visited SET is order-independent — counts stay exact.) The same
+        # approximation means that even UNCAPPED sharded deep runs report
+        # depth labels at first-claim: ``max_depth()`` and discovery-path
+        # lengths are upper bounds on the true BFS values (the host,
+        # single-device, and reference threaded-BFS checkers are the
+        # minimal-depth yardstick); counts and property verdicts are exact
+        # either way.
         if (
             self._max_drain_waves > 1
             and self._visitor is None
@@ -1294,7 +1302,11 @@ class ShardedTpuBfsChecker(Checker):
         children, parents = self._store.export()
         payload = {
             **checkpoint_header(
-                "sharded", self._model, self._A, self._symmetry_enabled
+                "sharded",
+                self._model,
+                self._A,
+                self._symmetry_enabled,
+                self._sym_scheme,
             ),
             "state_count": self._state_count,
             "unique_count": self._unique_count,
@@ -1332,6 +1344,7 @@ class ShardedTpuBfsChecker(Checker):
             self._model,
             self._A,
             self._symmetry_enabled,
+            self._sym_scheme,
         )
         self._state_count = payload["state_count"]
         self._unique_count = payload["unique_count"]

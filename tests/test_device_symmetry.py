@@ -208,14 +208,163 @@ def test_symmetry_checkpoint_resume(tmp_path):
     assert "symmetry" in str(mismatched.worker_error())
 
 
+def test_refined_keys_match_orbit_min_partition_2pc7():
+    """The WL-refined canonical keys must induce the SAME equivalence
+    partition as the exact n!-loop orbit-minimum keys — on the 5040-perm
+    group (n=7) where the n! loop is too slow to ever run per-wave. 256
+    random packed states plus a randomly permuted copy of each: the
+    permuted copies pin orbit invariance (same key as their original), the
+    cross-pairs pin that refinement never merges distinct orbits."""
+    import jax.numpy as jnp
+
+    from stateright_tpu.checker.builder import default_representative
+    from stateright_tpu.checker.tpu import _make_key_fn
+    from stateright_tpu.core.batch import BatchableModel
+    from stateright_tpu.ops.fingerprint import fingerprint_state
+
+    model = TwoPhaseSys(7)
+
+    def fp_fn(s):
+        return fingerprint_state(model.packed_fingerprint_view(s))
+
+    refined = _make_key_fn(model, fp_fn, default_representative)
+    orig = TwoPhaseSys.packed_refine_colors
+    try:
+        TwoPhaseSys.packed_refine_colors = BatchableModel.packed_refine_colors
+        orbit_min = _make_key_fn(model, fp_fn, default_representative)
+    finally:
+        TwoPhaseSys.packed_refine_colors = orig
+    assert refined is not orbit_min
+
+    rng = np.random.default_rng(7)
+    B, n = 256, 7
+    batch = {
+        "rm": jnp.asarray(rng.integers(0, 4, (B, n)), jnp.uint32),
+        "tm": jnp.asarray(rng.integers(0, 3, (B,)), jnp.uint32),
+        "prepared": jnp.asarray(rng.integers(0, 1 << n, (B,)), jnp.uint32),
+        "msgs": jnp.asarray(rng.integers(0, 1 << (n + 2), (B,)), jnp.uint32),
+    }
+    n2o, o2n = model.packed_symmetry()
+    pick = rng.integers(0, n2o.shape[0], (B,))
+    permuted = jax.vmap(model.packed_apply_permutation)(
+        batch, jnp.asarray(n2o[pick]), jnp.asarray(o2n[pick])
+    )
+    both = {k: jnp.concatenate([v, permuted[k]]) for k, v in batch.items()}
+
+    rhi, rlo = jax.jit(refined)(both)
+    mhi, mlo = jax.jit(orbit_min)(both)
+    rkey = (np.asarray(rhi).astype(np.uint64) << 32) | np.asarray(rlo)
+    mkey = (np.asarray(mhi).astype(np.uint64) << 32) | np.asarray(mlo)
+    # Orbit invariance: each permuted copy keys with its original.
+    assert (rkey[B:] == rkey[:B]).all()
+    # Same partition as the exact orbit-minimum keys.
+    assert (
+        (rkey[:, None] == rkey[None, :]) == (mkey[:, None] == mkey[None, :])
+    ).all()
+
+
+def test_weak_refine_hook_falls_back_exactly():
+    """A deliberately useless refine hook (constant colors — a single tie
+    class everywhere) must cost only speed, never counts: the adjacent-
+    transposition verification fails on every non-fully-symmetric state
+    and those lanes take the n!-loop fallback key."""
+    import jax.numpy as jnp
+
+    class WeakRefine2pc(TwoPhaseSys):
+        def packed_refine_colors(self, state, colors):
+            return jnp.zeros_like(colors)
+
+    checker = _tpu_sym(WeakRefine2pc(5))
+    assert checker.unique_state_count() == TWO_PC_5_ORBITS
+    checker.assert_properties()
+
+
+@pytest.mark.slow
+def test_2pc7_device_orbit_count():
+    """The n!-wall milestone: symmetry on the 5,040-permutation group
+    (2pc-7, 296,448 states) — infeasible under the r2 per-wave n! loop —
+    completes through the WL-refined keys. Orbit count pinned from the
+    first verified run (cross-checked by the partition-equality property
+    test above, which pins refined == orbit-min on this exact group)."""
+    checker = _tpu_sym(
+        TwoPhaseSys(7),
+        frontier_capacity=1 << 13,
+        table_capacity=1 << 20,
+        drain_log_factor=48,
+    )
+    assert checker.unique_state_count() == 920
+    checker.assert_properties()
+
+
 def test_custom_symmetry_fn_rejected_on_device():
     # Device symmetry reduces by the FULL permutation group; honoring a
-    # user's partial-symmetry representative is impossible, so it must
-    # refuse instead of silently over-merging states.
+    # user's partial-symmetry representative is impossible WITHOUT a
+    # packed canonical form, so it must refuse instead of silently
+    # over-merging states.
     with pytest.raises(ValueError):
         TwoPhaseSys(3).checker().symmetry_fn(
             lambda s: s.representative()
         ).spawn_tpu_bfs()
+
+
+def test_custom_packed_representative_on_device():
+    """A user-defined partial symmetry (reference ``Representative``,
+    ``src/checker/representative.rs:65-68``) drives device dedup when the
+    model implements ``packed_representative``: partial symmetry over only
+    the FIRST THREE RMs of a 4-RM 2pc. Host (symmetry_fn) and device
+    (packed_representative) canonicalize with different sort keys but
+    quotient by the same S_3 action, so the reduced counts must agree."""
+    import jax.numpy as jnp
+
+    from stateright_tpu.utils.rewrite import RewritePlan
+
+    K = 3
+
+    def rep3(state):
+        order = sorted(
+            range(K),
+            key=lambda i: (
+                state.rm_state[i],
+                state.tm_prepared[i],
+                ("Prepared", i) in state.msgs,
+            ),
+        )
+        mapping = list(range(len(state.rm_state)))
+        for new, old in enumerate(order):
+            mapping[old] = new
+        return state._permuted(RewritePlan(mapping))
+
+    class Partial2pc(TwoPhaseSys):
+        def packed_representative(self, state):
+            n = self.rm_count
+            idx = jnp.arange(n, dtype=jnp.uint32)
+            prep = (state["prepared"] >> idx) & jnp.uint32(1)
+            msg = (state["msgs"] >> idx) & jnp.uint32(1)
+            key = state["rm"] * jnp.uint32(4) + prep * jnp.uint32(2) + msg
+            order3 = jnp.argsort(key[:K]).astype(jnp.int32)
+            n2o = jnp.concatenate(
+                [order3, jnp.arange(K, n, dtype=jnp.int32)]
+            )
+            o2n = (
+                jnp.zeros((n,), jnp.int32)
+                .at[n2o]
+                .set(jnp.arange(n, dtype=jnp.int32))
+            )
+            return self.packed_apply_permutation(state, n2o, o2n)
+
+    host = Partial2pc(4).checker().symmetry_fn(rep3).spawn_dfs().join()
+    dev = (
+        Partial2pc(4)
+        .checker()
+        .symmetry_fn(rep3)
+        .spawn_tpu_bfs(frontier_capacity=256, table_capacity=1 << 14)
+        .join()
+    )
+    assert dev.worker_error() is None
+    assert dev.unique_state_count() == host.unique_state_count()
+    # A partial symmetry must still reduce vs the unreduced space.
+    full = TwoPhaseSys(4).checker().spawn_bfs().join()
+    assert dev.unique_state_count() < full.unique_state_count()
 
 
 def test_symmetry_requires_packed_support():

@@ -78,6 +78,46 @@ def test_raft_crash_sharded_parity():
     assert checker.unique_state_count() == 2252
 
 
+def test_nonempty_initial_network_packs_with_host_parity():
+    """Pre-seeded initial networks (reference ``ActorModel::init_network``,
+    ``src/actor/model.rs:96-100``) stage onto the device path: the packed
+    init states carry the seeded envelopes, and counts match the host
+    checker exactly. Seeds a RequestVote so server 1 can immediately grant
+    a vote it would otherwise only see after a timeout."""
+    from stateright_tpu.actor.network import Envelope
+
+    seeded = Network.new_unordered_nonduplicating(
+        [Envelope(src=0, dst=1, msg=("RequestVote", 1))]
+    )
+    cfg = RaftModelCfg(
+        server_count=3, max_term=1, lossy=True, network=seeded
+    )
+    host = cfg.into_model().checker().spawn_bfs().join()
+    dev = _tpu(cfg.into_model())
+    assert dev.unique_state_count() == host.unique_state_count()
+    assert set(dev.discoveries()) == set(host.discoveries())
+
+
+def test_nonempty_initial_ordered_network_packs_with_host_parity():
+    """Same, over per-pair FIFO flows: the seeded queue order is the
+    packed flows' positional canonical order."""
+    from stateright_tpu.actor.network import Envelope
+
+    seeded = Network.new_ordered(
+        [
+            Envelope(src=0, dst=1, msg=("RequestVote", 1)),
+            Envelope(src=2, dst=1, msg=("RequestVote", 1)),
+        ]
+    )
+    cfg = RaftModelCfg(
+        server_count=3, max_term=1, lossy=False, network=seeded
+    )
+    host = cfg.into_model().checker().spawn_bfs().join()
+    dev = _tpu(cfg.into_model())
+    assert dev.unique_state_count() == host.unique_state_count()
+    assert set(dev.discoveries()) == set(host.discoveries())
+
+
 @pytest.mark.slow
 def test_ordered_abd_3_clients_bench_family_parity():
     """The `linearizable-register check 3 ordered` bench-family config

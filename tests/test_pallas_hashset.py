@@ -151,3 +151,28 @@ def test_probe_overflow_reports_pending():
     )
     assert int(np.asarray(fresh).sum()) == MAX_PROBES
     assert int(np.asarray(pend).sum()) == 16
+
+
+def test_checker_hashset_impl_pallas_oracle():
+    """The checker-level dispatch (`spawn_tpu_bfs(hashset_impl="pallas")`):
+    a whole exhaustive check through the Pallas insert (interpret mode
+    off-TPU) must reproduce the 2pc-3 oracle. Pins the _insert_sorted
+    wiring, the TILE_ROWS capacity validation path, and the mixed
+    pallas-wave/XLA-rehash table interplay."""
+    from stateright_tpu.models.two_phase_commit import TwoPhaseSys
+
+    checker = (
+        TwoPhaseSys(3)
+        .checker()
+        .spawn_tpu_bfs(frontier_capacity=64, table_capacity=TILE_ROWS,
+                       hashset_impl="pallas")
+        .join()
+    )
+    assert checker.worker_error() is None
+    assert checker.unique_state_count() == 288
+    checker.assert_properties()
+
+    with pytest.raises(ValueError):
+        TwoPhaseSys(3).checker().spawn_tpu_bfs(
+            table_capacity=TILE_ROWS + 1, hashset_impl="pallas"
+        )
