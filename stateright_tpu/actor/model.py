@@ -108,6 +108,11 @@ class ActorModel(Model):
         self._lossy_network: bool = LOSSLESS
         self._max_crashes: int = 0
         self._properties: List[Property] = []
+        # Original append positions, kept parallel to ``_properties`` so a
+        # codec's positionally-aligned ``packed_conditions`` list can be
+        # filtered consistently after ``retain_properties``.
+        self._property_codec_pos: List[int] = []
+        self._properties_added: int = 0
         self._record_msg_in: Callable = lambda cfg, history, env: None
         self._record_msg_out: Callable = lambda cfg, history, env: None
         self._within_boundary: Callable = lambda cfg, state: True
@@ -141,6 +146,28 @@ class ActorModel(Model):
         if name is None and condition is None:
             return Model.property(self, expectation)
         self._properties.append(Property(expectation, name, condition))
+        self._property_codec_pos.append(self._properties_added)
+        self._properties_added += 1
+        return self
+
+    def retain_properties(self, *names: str) -> "ActorModel":
+        """Keeps only the named properties (e.g. to benchmark
+        time-to-counterexample on a single falsifiable liveness property —
+        checkers finish early once every remaining property has a
+        discovery). Packed codecs stay aligned: their positional
+        ``packed_conditions`` list is filtered by the same positions."""
+        if not names:
+            raise ValueError(
+                "retain_properties needs at least one property name "
+                "(a checker with no properties explores nothing)"
+            )
+        have = {p.name for p in self._properties}
+        missing = [n for n in names if n not in have]
+        if missing:
+            raise ValueError(f"unknown properties: {missing} (have {sorted(have)})")
+        keep = [i for i, p in enumerate(self._properties) if p.name in names]
+        self._properties = [self._properties[i] for i in keep]
+        self._property_codec_pos = [self._property_codec_pos[i] for i in keep]
         return self
 
     def record_msg_in(self, fn) -> "ActorModel":
