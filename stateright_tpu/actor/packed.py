@@ -529,14 +529,14 @@ class PackedActorModel(ActorModel, BatchableModel):
         # every such tie pays the n! fallback). References are detected
         # generically and exactly: rewrites gather by INDEX, so perturbing
         # slot j's name changes exactly the rows that reference j.
-        rev = jnp.zeros((n,), u)
         hcol = avalanche32(colors * u(0x27D4EB2F) + u(0x165667B1))
-        for j in range(n):
+
+        def rev_body(j, rev):
             cj = colors.at[j].set(colors[j] ^ u(0x80000001))
             refs = (rows_under(cj) != rows_c).any(axis=1)
-            rev = rev.at[j].set(
-                jnp.where(refs, hcol, u(0)).sum(dtype=u)
-            )
+            return rev.at[j].set(jnp.where(refs, hcol, u(0)).sum(dtype=u))
+
+        rev = jax.lax.fori_loop(0, n, rev_body, jnp.zeros((n,), u))
         acc = avalanche32(acc ^ rev * u(0x9E3779B7))
 
         if self._ordered:

@@ -315,32 +315,40 @@ def _make_key_fn(model, fp_fn, symmetry):
     # rounds only re-hash a stable partition.
     rounds = max(1, min(n - 1, 6))
     iota = jnp.arange(n, dtype=jnp.int32)
-    # Static adjacent-transposition index vectors (swap positions i, i+1).
-    swaps = []
+    # Adjacent-transposition index table, row i = identity with (i, i+1)
+    # swapped. Both this loop and the refine loop run as fori_loops, not
+    # unrolled — the key fn is traced inside every checker's wave/drain,
+    # and an n-times-smaller HLO is real compile-warmup savings on the
+    # slow-compile device tunnel (semantics are iteration-identical).
+    swap_rows = np.tile(np.arange(n, dtype=np.int32), (max(n - 1, 1), 1))
     for i in range(n - 1):
-        sw = list(range(n))
-        sw[i], sw[i + 1] = sw[i + 1], sw[i]
-        swaps.append(jnp.asarray(sw, jnp.int32))
+        swap_rows[i, i], swap_rows[i, i + 1] = i + 1, i
+    swap_tab = jnp.asarray(swap_rows)
 
     def refined_keys(states_batch):
         def one(s):
-            colors = jnp.zeros((n,), jnp.uint32)
-            for _ in range(rounds):
-                colors = model.packed_refine_colors(s, colors)
+            colors = jax.lax.fori_loop(
+                0,
+                rounds,
+                lambda _i, c: model.packed_refine_colors(s, c),
+                jnp.zeros((n,), jnp.uint32),
+            )
             sorted_colors, cand = jax.lax.sort(
                 (colors, iota), num_keys=1
             )
             inv = jnp.zeros((n,), jnp.int32).at[cand].set(iota)
             hi0, lo0 = fp_fn(model.packed_apply_permutation(s, cand, inv))
-            ok = jnp.bool_(True)
-            for i in range(n - 1):
+
+            def check(i, ok):
                 tie = sorted_colors[i] == sorted_colors[i + 1]
-                cand_i = cand[swaps[i]]
+                cand_i = cand[swap_tab[i]]
                 inv_i = jnp.zeros((n,), jnp.int32).at[cand_i].set(iota)
                 hi_i, lo_i = fp_fn(
                     model.packed_apply_permutation(s, cand_i, inv_i)
                 )
-                ok = ok & (~tie | ((hi_i == hi0) & (lo_i == lo0)))
+                return ok & (~tie | ((hi_i == hi0) & (lo_i == lo0)))
+
+            ok = jax.lax.fori_loop(0, n - 1, check, jnp.bool_(True))
             return hi0, lo0, ok
 
         khi, klo, ok = jax.vmap(one)(states_batch)
