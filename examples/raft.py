@@ -26,14 +26,17 @@ def _cfg(server_count, max_term, network):
 
 def main(argv=sys.argv):
     cmd, free = parse_args(argv)
-    if cmd in ("check", "check-sym"):
+    if cmd in ("check", "check-sym", "check-live"):
         server_count = opt_int(free, 0, 5)
         max_term = opt_int(free, 1, 2)
         network = opt_network(free, 2)
-        sym = " with symmetry reduction" if cmd == "check-sym" else ""
+        mode = {
+            "check-sym": " with symmetry reduction",
+            "check-live": " with cycle-complete liveness",
+        }.get(cmd, "")
         print(
             f"Model checking Raft leader election with {server_count} servers"
-            f" (max term {max_term}){sym}."
+            f" (max term {max_term}){mode}."
         )
         builder = (
             _cfg(server_count, max_term, network)
@@ -43,6 +46,10 @@ def main(argv=sys.argv):
         )
         if cmd == "check-sym":
             builder = builder.symmetry()
+        if cmd == "check-live":
+            # Opt-in lasso search: catches repeated-election loops the
+            # reference's eventually semantics miss (see checker/liveness.py).
+            builder = builder.complete_liveness()
         report(builder.spawn_dfs())
     elif cmd == "explore":
         server_count = opt_int(free, 0, 3)
@@ -59,6 +66,7 @@ def main(argv=sys.argv):
         print("USAGE:")
         print("  ./raft.py check [SERVER_COUNT] [MAX_TERM] [NETWORK]")
         print("  ./raft.py check-sym [SERVER_COUNT] [MAX_TERM] [NETWORK]")
+        print("  ./raft.py check-live [SERVER_COUNT] [MAX_TERM] [NETWORK]")
         print("  ./raft.py explore [SERVER_COUNT] [ADDRESS] [NETWORK]")
         print(f"NETWORK: {network_names()}")
 

@@ -28,6 +28,7 @@ class CheckerBuilder:
         self._target_max_depth: Optional[int] = None
         self._thread_count: int = 1
         self._visitor: Optional[CheckerVisitor] = None
+        self._complete_liveness: bool = False
 
     # -- configuration -----------------------------------------------------
 
@@ -39,6 +40,18 @@ class CheckerBuilder:
 
     def symmetry_fn(self, representative: Callable) -> "CheckerBuilder":
         self._symmetry = representative
+        return self
+
+    def complete_liveness(self) -> "CheckerBuilder":
+        """Opt-in cycle-aware ``eventually`` checking (beyond the
+        reference, whose semantics miss counterexamples that loop —
+        documented FIXMEs at ``src/checker/bfs.rs:285-305``): after
+        exploration, every undiscovered ``eventually`` property gets a
+        host-side lasso search over the condition-false region
+        (``checker/liveness.py``). Costs O(|condition-false region|) host
+        time/memory, hence opt-in; the default semantics stay
+        reference-exact."""
+        self._complete_liveness = True
         return self
 
     def target_state_count(self, count: int) -> "CheckerBuilder":

@@ -1,0 +1,141 @@
+"""Complete (cycle-aware) ``eventually`` checking — opt-in, beyond the
+reference.
+
+The reference's BFS/DFS only flag an ``eventually`` counterexample at a
+TERMINAL state with the condition still unmet; paths that diverge into a
+cycle (or rejoin previously-visited states) are documented false negatives
+(FIXMEs at ``/root/reference/src/checker/bfs.rs:285-305``, test
+``src/checker.rs:642-659``). The default checkers here reproduce those
+semantics bit-for-bit (``tests/test_checker.py``) — counts and verdicts
+must not silently diverge from the reference.
+
+``CheckerBuilder.complete_liveness()`` adds the missing half as a
+post-pass: for every ``eventually`` property still without a discovery,
+search for a **lasso** — a path from an initial state that never satisfies
+the condition and closes a cycle. Any infinite counterexample path in a
+finite space is exactly such a lasso, and any path that touches a
+satisfying state is no counterexample, so the search runs entirely inside
+the condition-false region: a host DFS from condition-false initial
+states, following only condition-false successors, looking for a back
+edge to a state on the current DFS path (gray). The resulting discovery
+is a finite certificate: a concrete path whose final state revisits an
+earlier state with the condition false at every step.
+
+The pass is self-contained (it re-expands on the host model; it does not
+need the checker's visited set), exact for finite boundaries, and costs
+O(size of the reachable condition-false region) in host time and memory —
+which is why it is opt-in rather than always-on.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ..core.model import Expectation, Property
+from ..core.path import Path
+
+__all__ = [
+    "find_eventually_lasso",
+    "lasso_discoveries",
+    "checker_lasso_pass",
+]
+
+
+def find_eventually_lasso(model, prop: Property) -> Optional[Path]:
+    """A lasso counterexample for one ``eventually`` property, or None.
+
+    Iterative DFS over the condition-false region with white/gray/black
+    coloring; a successor that is gray closes the cycle. States must be
+    hashable (the host checkers' standing requirement).
+    """
+    cond = prop.condition
+
+    def false_succs(state):
+        acts: List = []
+        model.actions(state, acts)
+        for a in acts:
+            ns = model.next_state(state, a)
+            if (
+                ns is not None
+                and model.within_boundary(ns)
+                and not cond(model, ns)
+            ):
+                yield a, ns
+
+    WHITE, GRAY, BLACK = 0, 1, 2
+    color: Dict = {}
+    for init in model.init_states():
+        if not model.within_boundary(init) or cond(model, init):
+            continue
+        if color.get(init, WHITE) != WHITE:
+            continue
+        color[init] = GRAY
+        stack = [(init, false_succs(init))]
+        trail: List = [init]  # states on the current DFS path
+        actions: List = []  # actions between them (len == len(trail) - 1)
+        while stack:
+            state, it = stack[-1]
+            descended = False
+            for action, nxt in it:
+                c = color.get(nxt, WHITE)
+                if c == GRAY:
+                    # Cycle: trail + the closing edge revisits `nxt`.
+                    steps = [
+                        (s, a) for s, a in zip(trail, actions + [action])
+                    ]
+                    steps.append((nxt, None))
+                    return Path(steps)
+                if c == WHITE:
+                    color[nxt] = GRAY
+                    stack.append((nxt, false_succs(nxt)))
+                    trail.append(nxt)
+                    actions.append(action)
+                    descended = True
+                    break
+            if not descended:
+                color[state] = BLACK
+                stack.pop()
+                trail.pop()
+                if actions:
+                    actions.pop()
+    return None
+
+
+def checker_lasso_pass(checker, done: bool, have) -> Dict[str, Path]:
+    """The lazy post-pass every checker's ``discoveries()`` shares.
+
+    Runs once per checker (cached under ``checker._lasso_lock``) when the
+    opt-in flag is set AND exploration finished cleanly — a crashed run
+    must not launch an unbounded host DFS from ``discoveries()`` (callers
+    often inspect a failed checker), nor report counterexamples for a run
+    that never completed. ``have`` is the checker's existing
+    discovery-name collection (terminal-state counterexamples win)."""
+    if not checker._complete_liveness or not done:
+        return {}
+    if checker.worker_error() is not None:
+        return {}
+    with checker._lasso_lock:
+        if checker._lassos is None:
+            props = getattr(checker, "_properties", None)
+            if props is None:
+                props = checker._model.properties()
+            checker._lassos = lasso_discoveries(
+                checker._model, props, set(have)
+            )
+    return checker._lassos
+
+
+def lasso_discoveries(model, properties, have) -> Dict[str, Path]:
+    """Lasso counterexamples for every undiscovered ``eventually``
+    property. ``have`` is the checker's existing discovery-name set
+    (first-found wins; terminal-state counterexamples stay as-is)."""
+    out: Dict[str, Path] = {}
+    for prop in properties:
+        if prop.expectation != Expectation.EVENTUALLY:
+            continue
+        if prop.name in have:
+            continue
+        path = find_eventually_lasso(model, prop)
+        if path is not None:
+            out[prop.name] = path
+    return out

@@ -149,6 +149,9 @@ class ShardedTpuBfsChecker(Checker):
         self._visitor = options._visitor
         self._target_state_count: Optional[int] = options._target_state_count
         self._depth_cap = options._target_max_depth or _DEPTH_INF
+        self._complete_liveness: bool = options._complete_liveness
+        self._lassos: Optional[Dict[str, Path]] = None
+        self._lasso_lock = threading.Lock()
 
         self._checkpoint_path = checkpoint_path
         # Counts dequeued global chunks; the time floor keeps wide frontiers
@@ -199,6 +202,7 @@ class ShardedTpuBfsChecker(Checker):
                 check_vma=False,
             )
         )
+        self._wave_exec = {}  # (local capacity, chunk width) -> AOT wave
         self._jit_insert = jax.jit(
             shard_map(
                 self._insert_local,
@@ -942,16 +946,7 @@ class ShardedTpuBfsChecker(Checker):
 
             attempt = 0
             while True:
-                wave = self._jit_wave(
-                    table,
-                    dev["states"],
-                    dev["hi"],
-                    dev["lo"],
-                    dev["ebits"],
-                    dev["depth"],
-                    dev["mask"],
-                    depth_cap,
-                )
+                wave = self._call_wave(table, dev, depth_cap)
                 table = wave["table"]
                 if attempt == 0:
                     self._state_count += int(self._pull(wave["generated"]).sum())
@@ -982,6 +977,33 @@ class ShardedTpuBfsChecker(Checker):
                 self.warmup_seconds = time.perf_counter() - self._t_start
             # Re-ingest fresh rows for the next chunks.
             del dev
+
+    def _call_wave(self, table, dev, depth_cap):
+        """Wave through an AOT-compiled executable (keyed by local table
+        capacity): a mid-run compile (table growth changes the shape) is
+        measured into ``warmup_seconds`` instead of the steady-state
+        window — mirroring ``TpuBfsChecker._call_wave``. During the
+        pre-first-result window ``warmup_seconds`` is None and the
+        caller's own stamp covers the compile."""
+        args = (
+            table,
+            dev["states"],
+            dev["hi"],
+            dev["lo"],
+            dev["ebits"],
+            dev["depth"],
+            dev["mask"],
+            jnp.asarray(depth_cap, jnp.int32),
+        )
+        key = (table.shape[0], dev["hi"].shape[0])
+        exe = self._wave_exec.get(key)
+        if exe is None:
+            t0 = time.perf_counter()
+            exe = self._jit_wave.lower(*args).compile()
+            self._wave_exec[key] = exe
+            if self.warmup_seconds is not None:
+                self.warmup_seconds += time.perf_counter() - t0
+        return exe(*args)
 
     # -- deep-drain host loop ---------------------------------------------
 
@@ -1191,16 +1213,10 @@ class ShardedTpuBfsChecker(Checker):
             fr = res["frontier"]
             while True:
                 table = self._grow_table(table, self._cap_loc * 2)
-                wave = self._jit_wave(
-                    table,
-                    fr["states"],
-                    fr["hi"],
-                    fr["lo"],
-                    fr["ebits"],
-                    fr["depth"],
-                    fr["mask"],
-                    depth_cap,
-                )
+                # Through the AOT cache: the grown-shape compile is
+                # measured into warmup, and the executable is shared with
+                # the wave path.
+                wave = self._call_wave(table, fr, depth_cap)
                 table = wave["table"]
                 self._harvest(wave)
                 if not int(self._pull(wave["overflow"]).sum()):
@@ -1481,10 +1497,18 @@ class ShardedTpuBfsChecker(Checker):
         return self._max_depth
 
     def discoveries(self) -> Dict[str, Path]:
-        return {
+        out = {
             name: self._reconstruct(fp)
             for name, fp in list(self._discoveries_fp.items())
         }
+        from ..checker.liveness import checker_lasso_pass
+
+        out.update(
+            checker_lasso_pass(
+                self, self._done_event.is_set(), self._discoveries_fp
+            )
+        )
+        return out
 
     def handles(self) -> List[threading.Thread]:
         handles, self._handles = self._handles, []

@@ -71,6 +71,7 @@ def test_2pc5_sharded_orbit_count_matches():
     checker.assert_properties()
 
 
+@pytest.mark.slow
 def test_raft_device_orbit_count_and_host_parity():
     dev = _tpu_sym(_raft_dup(), table_capacity=1 << 12)
     assert dev.unique_state_count() == RAFT_DUP_LOSSY_ORBITS
@@ -170,6 +171,7 @@ def test_device_group_action_matches_host():
             ), (p, s)
 
 
+@pytest.mark.slow
 def test_symmetry_checkpoint_resume(tmp_path):
     ckpt = tmp_path / "2pc4-sym.ckpt"
     first = (

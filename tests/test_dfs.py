@@ -12,6 +12,7 @@ def test_visits_states_in_dfs_order():
     assert recorder.states == [(0, 0)] + [(0, y) for y in range(1, 28)]
 
 
+@pytest.mark.slow
 def test_can_complete_by_enumerating_all_states():
     checker = LinearEquation(2, 4, 7).checker().spawn_dfs().join()
     assert checker.is_done()

@@ -1,0 +1,150 @@
+"""Opt-in cycle-aware ``eventually`` checking (`.complete_liveness()`).
+
+The DEFAULT semantics reproduce the reference's documented false negatives
+on cycles and DAG joins bit-for-bit (tests/test_checker.py pins that). The
+opt-in post-pass closes them: a lasso — a condition-false path closing a
+cycle — is exactly an infinite counterexample in a finite space. The
+reference has no equivalent (FIXMEs at ``src/checker/bfs.rs:285-305``).
+"""
+
+import jax.numpy as jnp
+
+from fixtures import DGraph
+from stateright_tpu import Property
+from stateright_tpu.core.batch import BatchableModel
+from stateright_tpu.core.model import Model
+
+
+def eventually_odd():
+    return Property.eventually("odd", lambda _, s: s % 2 == 1)
+
+
+def test_lasso_found_on_cycle_host_bfs():
+    # The reference's own FIXME case: 0 -> 2 -> 4 -> 2 never hits an odd
+    # state; default semantics miss it (no terminal state), the lasso pass
+    # finds it with a certificate that revisits a state.
+    checker = (
+        DGraph.with_property(eventually_odd())
+        .with_path([0, 2, 4, 2])
+        .checker()
+        .complete_liveness()
+        .spawn_bfs()
+        .join()
+    )
+    path = checker.discoveries().get("odd")
+    assert path is not None
+    states = path.into_states()
+    assert all(s % 2 == 0 for s in states)
+    assert states[-1] in states[:-1]  # the lasso certificate
+
+
+def test_lasso_found_on_dag_join_cycle_host_dfs():
+    checker = (
+        DGraph.with_property(eventually_odd())
+        .with_path([0, 2, 4, 2])
+        .checker()
+        .complete_liveness()
+        .spawn_dfs()
+        .join()
+    )
+    assert "odd" in checker.discoveries()
+
+
+def test_no_lasso_when_cycle_passes_through_satisfying_state():
+    # 0 -> 1 -> 2 -> 0 loops, but through odd 1: every infinite path
+    # satisfies the property, so the pass must find nothing.
+    checker = (
+        DGraph.with_property(eventually_odd())
+        .with_path([0, 1, 2, 0])
+        .checker()
+        .complete_liveness()
+        .spawn_bfs()
+        .join()
+    )
+    assert checker.discoveries() == {}
+    checker.assert_properties()
+
+
+def test_terminal_counterexample_still_preferred():
+    # A terminal even path: the standard semantics find it; the pass must
+    # not override the existing discovery.
+    checker = (
+        DGraph.with_property(eventually_odd())
+        .with_path([0, 2])
+        .checker()
+        .complete_liveness()
+        .spawn_bfs()
+        .join()
+    )
+    d = checker.discoveries()["odd"]
+    assert d.into_states() == [0, 2]
+
+
+class _Cycler(Model, BatchableModel):
+    """0 -> 1 -> 2 -> 1: the cycle {1, 2} never reaches 3."""
+
+    def init_states(self):
+        return [0]
+
+    def actions(self, state, actions):
+        actions.append("step")
+
+    def next_state(self, state, action):
+        return {0: 1, 1: 2, 2: 1}[state]
+
+    def properties(self):
+        return [Property.eventually("three", lambda _, s: s == 3)]
+
+    # -- packed protocol ---------------------------------------------------
+
+    def packed_action_count(self):
+        return 1
+
+    def packed_init_states(self):
+        return {"s": jnp.zeros((1,), jnp.uint32)}
+
+    def packed_step(self, state, action_id):
+        s = state["s"]
+        nxt = jnp.where(s == 0, jnp.uint32(1),
+                        jnp.where(s == 1, jnp.uint32(2), jnp.uint32(1)))
+        return {"s": nxt}, jnp.bool_(True)
+
+    def packed_conditions(self):
+        return [lambda st: st["s"] == 3]
+
+    def pack_state(self, host_state):
+        import numpy as np
+
+        return {"s": np.uint32(host_state)}
+
+    def unpack_state(self, packed):
+        return int(packed["s"])
+
+
+def test_lasso_pass_composes_with_device_checker():
+    # The pass is checker-independent (host-side, self-contained); wired
+    # into TpuBfsChecker it fires after the device exploration finishes.
+    dev = (
+        _Cycler()
+        .checker()
+        .complete_liveness()
+        .spawn_tpu_bfs(frontier_capacity=16, table_capacity=1 << 9)
+        .join()
+    )
+    assert dev.worker_error() is None
+    path = dev.discoveries().get("three")
+    assert path is not None
+    states = path.into_states()
+    assert states[-1] in states[:-1]
+    assert 3 not in states
+
+    # Without the flag, the device checker reproduces the reference's
+    # false negative (no terminal state -> no discovery).
+    plain = (
+        _Cycler()
+        .checker()
+        .spawn_tpu_bfs(frontier_capacity=16, table_capacity=1 << 9)
+        .join()
+    )
+    assert plain.worker_error() is None
+    assert plain.discoveries() == {}

@@ -54,6 +54,7 @@ def test_parity_lossy_nonduplicating():
     assert _tpu(cfg).unique_state_count() == 665
 
 
+@pytest.mark.slow
 def test_parity_on_sharded_mesh():
     checker = (
         RaftModelCfg(server_count=3, max_term=1, lossy=True)

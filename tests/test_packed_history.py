@@ -91,6 +91,7 @@ def test_device_predicate_matches_host_tester(cfg, expect_violations):
 # -- exact device/host count parity (reference oracle counts) -----------------
 
 
+@pytest.mark.slow
 def test_paxos_device_parity_16668():
     checker = _tpu(
         PaxosModelCfg(2, 3).into_model(),
@@ -102,6 +103,7 @@ def test_paxos_device_parity_16668():
     assert set(checker.discoveries()) == {"value chosen"}
 
 
+@pytest.mark.slow
 def test_abd_device_parity_544():
     checker = _tpu(AbdModelCfg(2, 2).into_model())
     assert checker.unique_state_count() == 544
@@ -115,6 +117,7 @@ def test_single_copy_device_parity_93():
     checker.assert_properties()
 
 
+@pytest.mark.slow
 def test_single_copy_two_servers_not_linearizable_on_device():
     checker = _tpu(SingleCopyModelCfg(2, 2).into_model())
     disc = checker.discoveries()
@@ -123,6 +126,7 @@ def test_single_copy_two_servers_not_linearizable_on_device():
     assert len(disc["linearizable"].into_vec()) >= 2
 
 
+@pytest.mark.slow
 def test_paxos_sharded_parity():
     import jax as _jax
     from jax.sharding import Mesh

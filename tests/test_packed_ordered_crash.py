@@ -24,6 +24,7 @@ def _tpu(model, **kw):
     return checker
 
 
+@pytest.mark.slow
 def test_ordered_abd_round_trip_and_parity():
     # The `linearizable-register check N ordered` bench family
     # (reference bench.sh:31-34), scaled to the 2-client config.
@@ -39,6 +40,7 @@ def test_ordered_abd_round_trip_and_parity():
     dev.assert_properties()
 
 
+@pytest.mark.slow
 def test_raft_crash_faults_parity():
     model = RaftModelCfg(
         server_count=3, max_term=1, lossy=True, max_crashes=1
@@ -60,6 +62,7 @@ def test_crashed_flags_excluded_from_fingerprint():
     assert "crashed" in packed and "crashed" not in view
 
 
+@pytest.mark.slow
 def test_raft_crash_sharded_parity():
     import jax
     from jax.sharding import Mesh
@@ -98,6 +101,7 @@ def test_nonempty_initial_network_packs_with_host_parity():
     assert set(dev.discoveries()) == set(host.discoveries())
 
 
+@pytest.mark.slow
 def test_nonempty_initial_ordered_network_packs_with_host_parity():
     """Same, over per-pair FIFO flows: the seeded queue order is the
     packed flows' positional canonical order."""

@@ -9,6 +9,7 @@ semantics — see ``tests/test_device_symmetry.py``) at 4 nodes (lossy) and
 5 nodes (lossless, the full 120-permutation group).
 """
 
+import pytest
 import numpy as np
 
 import jax
@@ -21,6 +22,7 @@ RAFT4_LOSSY = 24_545
 RAFT4_LOSSY_ORBITS = 1_181
 
 
+@pytest.mark.slow
 def test_raft5_lossless_device_and_sharded_parity():
     dev = (
         RaftModelCfg(server_count=5, max_term=1, lossy=False)
@@ -51,6 +53,7 @@ def test_raft5_lossless_device_and_sharded_parity():
     assert "stable leader" in dev.discoveries()
 
 
+@pytest.mark.slow
 def test_raft5_lossless_symmetry_orbits():
     c = (
         RaftModelCfg(server_count=5, max_term=1, lossy=False)
@@ -64,6 +67,7 @@ def test_raft5_lossless_symmetry_orbits():
     assert c.unique_state_count() == RAFT5_LOSSLESS_ORBITS
 
 
+@pytest.mark.slow
 def test_raft4_lossy_symmetry_orbits():
     full = (
         RaftModelCfg(server_count=4, max_term=1, lossy=True)

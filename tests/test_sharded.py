@@ -78,6 +78,7 @@ def test_sharded_target_max_depth():
     assert capped.unique_state_count() < full.unique_state_count()
 
 
+@pytest.mark.slow
 def test_sharded_eventually_counterexample_replays():
     # The Raft liveness oracle (tests/test_raft.py) on the sharded mesh:
     # "stable leader" is an eventually property whose counterexample is a
@@ -119,6 +120,7 @@ def test_sharded_submesh_sizes(n_dev):
     assert checker.unique_state_count() == 288
 
 
+@pytest.mark.slow
 def test_sharded_deep_drain_tiny_rings_and_log():
     """Forces the deep drain through its host-exit machinery: a tiny log
     (many log-full exits), tiny rings (growth via export + re-push), and a
@@ -157,6 +159,7 @@ def test_sharded_waves_mode_still_exact():
     assert checker.unique_state_count() == 288
 
 
+@pytest.mark.slow
 def test_sharded_one_lane_frontier_grow_until_fits():
     """frontier_per_device=1 makes the round-robin receive quota
     (n*ceil(B/n)) comparable to the whole ring — the host push path must

@@ -235,6 +235,7 @@ def test_unbatchable_model_rejected():
         model.checker().spawn_tpu_bfs()
 
 
+@pytest.mark.slow
 def test_deep_drain_tiny_ring_and_log_exact():
     """Forces the deep drain's stress machinery — ring growth
     (export + re-push), log-full drain exits, and host-queue spill
