@@ -187,8 +187,9 @@ def _run_leg(leg: str, pin_cpu: bool):
     # previous round) already built — through the device tunnel that is
     # 30-40s per jitted shape. Warmup accounting stays honest: cache hits
     # simply shrink warmup_seconds.
-    jax.config.update("jax_compilation_cache_dir", "/tmp/jax_comp_cache")
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    from stateright_tpu.utils.compile_cache import enable_persistent_cache
+
+    enable_persistent_cache()
     if pin_cpu:
         # sitecustomize forces jax_platforms=axon,cpu via jax.config, which
         # overrides the JAX_PLATFORMS env var — re-pin through the config.

@@ -62,6 +62,38 @@ class Checker(Generic[State, Action]):
         """The first exception raised by a worker thread, if any."""
         return None
 
+    # -- complete-liveness plumbing (shared by every spawning checker) ------
+
+    def _setup_lasso(self, options) -> None:
+        """Initializes the opt-in lasso-pass state (see checker/liveness.py)
+        from the builder options. Refuses capped runs up front: the lasso
+        search explores the whole condition-false region regardless of
+        ``target_state_count``/``target_max_depth``, so on a model whose
+        space is finite only because of the caps it would never terminate,
+        and even when it did, its certificates could exceed the caps."""
+        self._complete_liveness: bool = options._complete_liveness
+        if self._complete_liveness and (
+            options._target_state_count is not None
+            or options._target_max_depth is not None
+        ):
+            raise ValueError(
+                "complete_liveness() requires an uncapped run: the lasso "
+                "search ignores target_state_count/target_max_depth and "
+                "would search the full condition-false region"
+            )
+        self._lassos: Optional[Dict[str, Path]] = None
+        self._lasso_lock = threading.Lock()
+
+    def _with_lassos(self, out: Dict[str, Path], done: bool, have):
+        """Merges lasso counterexamples into ``out`` WITHOUT overriding
+        existing entries — a terminal-state discovery recorded after the
+        pass was cached must keep precedence."""
+        from .liveness import checker_lasso_pass
+
+        for name, path in checker_lasso_pass(self, done, have).items():
+            out.setdefault(name, path)
+        return out
+
     # -- shared behavior ---------------------------------------------------
 
     def join(self) -> "Checker":

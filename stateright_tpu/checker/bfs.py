@@ -33,9 +33,7 @@ class BfsChecker(Checker):
         self._model = model
         self._target_state_count: Optional[int] = options._target_state_count
         self._target_max_depth: Optional[int] = options._target_max_depth
-        self._complete_liveness: bool = options._complete_liveness
-        self._lassos: Optional[Dict[str, Path]] = None
-        self._lasso_lock = threading.Lock()
+        self._setup_lasso(options)
         thread_count = max(1, options._thread_count)
         visitor = options._visitor
         properties = model.properties()
@@ -206,14 +204,9 @@ class BfsChecker(Checker):
             name: reconstruct_path(self._model, self._generated, fp)
             for name, fp in list(self._discoveries.items())
         }
-        from .liveness import checker_lasso_pass
-
-        out.update(
-            checker_lasso_pass(
-                self, self._job_broker.is_closed(), self._discoveries
-            )
+        return self._with_lassos(
+            out, self._job_broker.is_closed(), self._discoveries
         )
-        return out
 
     def handles(self) -> List[threading.Thread]:
         handles, self._handles = self._handles, []

@@ -50,7 +50,10 @@ class CheckerBuilder:
         host-side lasso search over the condition-false region
         (``checker/liveness.py``). Costs O(|condition-false region|) host
         time/memory, hence opt-in; the default semantics stay
-        reference-exact."""
+        reference-exact. Honored by the exhaustive checkers
+        (bfs/dfs/tpu_bfs/sharded_tpu_bfs), which refuse capped runs
+        (``target_state_count``/``target_max_depth``) under this flag —
+        the lasso search cannot honor caps."""
         self._complete_liveness = True
         return self
 

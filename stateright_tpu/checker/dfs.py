@@ -34,9 +34,7 @@ class DfsChecker(Checker):
         symmetry = options._symmetry
         self._target_state_count: Optional[int] = options._target_state_count
         self._target_max_depth: Optional[int] = options._target_max_depth
-        self._complete_liveness: bool = options._complete_liveness
-        self._lassos = None
-        self._lasso_lock = threading.Lock()
+        self._setup_lasso(options)
         thread_count = max(1, options._thread_count)
         visitor = options._visitor
         properties = model.properties()
@@ -214,14 +212,9 @@ class DfsChecker(Checker):
             name: Path.from_fingerprints(self._model, fps)
             for name, fps in list(self._discoveries.items())
         }
-        from .liveness import checker_lasso_pass
-
-        out.update(
-            checker_lasso_pass(
-                self, self._job_broker.is_closed(), self._discoveries
-            )
+        return self._with_lassos(
+            out, self._job_broker.is_closed(), self._discoveries
         )
-        return out
 
     def handles(self) -> List[threading.Thread]:
         handles, self._handles = self._handles, []

@@ -149,9 +149,7 @@ class ShardedTpuBfsChecker(Checker):
         self._visitor = options._visitor
         self._target_state_count: Optional[int] = options._target_state_count
         self._depth_cap = options._target_max_depth or _DEPTH_INF
-        self._complete_liveness: bool = options._complete_liveness
-        self._lassos: Optional[Dict[str, Path]] = None
-        self._lasso_lock = threading.Lock()
+        self._setup_lasso(options)
 
         self._checkpoint_path = checkpoint_path
         # Counts dequeued global chunks; the time floor keeps wide frontiers
@@ -1501,14 +1499,9 @@ class ShardedTpuBfsChecker(Checker):
             name: self._reconstruct(fp)
             for name, fp in list(self._discoveries_fp.items())
         }
-        from ..checker.liveness import checker_lasso_pass
-
-        out.update(
-            checker_lasso_pass(
-                self, self._done_event.is_set(), self._discoveries_fp
-            )
+        return self._with_lassos(
+            out, self._done_event.is_set(), self._discoveries_fp
         )
-        return out
 
     def handles(self) -> List[threading.Thread]:
         handles, self._handles = self._handles, []

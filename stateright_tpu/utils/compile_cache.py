@@ -1,0 +1,27 @@
+"""Shared persistent-compilation-cache setup.
+
+One definition of the cache location, used by tests/conftest.py,
+scripts/cpu_pin.py, and bench.py's per-leg subprocesses — a split cache
+silently loses the cross-run hits the warmup accounting depends on. The
+directory is per-uid (shared hosts must not collide on a world-writable
+path), and entries key on the HLO hash, so source changes miss naturally.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+
+
+def cache_dir() -> str:
+    return os.path.join(
+        tempfile.gettempdir(), f"jax_comp_cache_{os.getuid()}"
+    )
+
+
+def enable_persistent_cache() -> None:
+    """Call after importing jax (and after any platform re-pin)."""
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", cache_dir())
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)

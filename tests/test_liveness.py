@@ -38,6 +38,22 @@ def test_lasso_found_on_cycle_host_bfs():
     assert states[-1] in states[:-1]  # the lasso certificate
 
 
+def test_complete_liveness_refuses_capped_runs():
+    # The lasso search ignores exploration caps, so a capped run could
+    # hang on cap-bounded models and report over-cap certificates.
+    import pytest
+
+    with pytest.raises(ValueError):
+        (
+            DGraph.with_property(eventually_odd())
+            .with_path([0, 2])
+            .checker()
+            .complete_liveness()
+            .target_max_depth(3)
+            .spawn_bfs()
+        )
+
+
 def test_lasso_found_on_dag_join_cycle_host_dfs():
     checker = (
         DGraph.with_property(eventually_odd())
