@@ -37,6 +37,22 @@ def _tpu_sym(model, **kw):
     return checker
 
 
+def _sharded_sym(model, **kw):
+    from jax.sharding import Mesh
+
+    kw.setdefault("frontier_per_device", 64)
+    kw.setdefault("table_capacity_per_device", 1 << 10)
+    mesh = Mesh(np.array(jax.devices()[:8]), ("fp",))
+    checker = (
+        model.checker()
+        .symmetry()
+        .spawn_sharded_tpu_bfs(mesh=mesh, **kw)
+        .join()
+    )
+    assert checker.worker_error() is None
+    return checker
+
+
 def _raft_dup():
     return RaftModelCfg(
         server_count=3,
@@ -54,19 +70,7 @@ def test_2pc5_device_orbit_count():
 
 
 def test_2pc5_sharded_orbit_count_matches():
-    from jax.sharding import Mesh
-
-    mesh = Mesh(np.array(jax.devices()[:8]), ("fp",))
-    checker = (
-        TwoPhaseSys(5)
-        .checker()
-        .symmetry()
-        .spawn_sharded_tpu_bfs(
-            mesh=mesh, frontier_per_device=64, table_capacity_per_device=1 << 10
-        )
-        .join()
-    )
-    assert checker.worker_error() is None
+    checker = _sharded_sym(TwoPhaseSys(5))
     assert checker.unique_state_count() == TWO_PC_5_ORBITS
     checker.assert_properties()
 
@@ -278,6 +282,20 @@ def test_weak_refine_hook_falls_back_exactly():
 
     checker = _tpu_sym(WeakRefine2pc(5))
     assert checker.unique_state_count() == TWO_PC_5_ORBITS
+    checker.assert_properties()
+
+
+@pytest.mark.slow
+def test_2pc7_sharded_orbit_count_matches():
+    """The 5,040-perm WL keys computed inside the shard_map wave must
+    reproduce the single-device orbit count — two independent dedup/
+    routing implementations agreeing on the canonical partition."""
+    checker = _sharded_sym(
+        TwoPhaseSys(7),
+        frontier_per_device=1 << 10,
+        table_capacity_per_device=1 << 17,
+    )
+    assert checker.unique_state_count() == 920
     checker.assert_properties()
 
 
