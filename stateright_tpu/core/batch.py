@@ -28,8 +28,12 @@ import numpy as np
 
 PackedState = Any  # pytree of arrays
 
-# Beyond this the n! permutation table dwarfs any state-space saving.
-MAX_SYMMETRY_ACTORS = 8
+# Bound on the n! permutation table. Since r3 the table is only the
+# verify-or-fallback path behind the WL canonical keys (see
+# checker/tpu._make_key_fn) — the common case never executes it — but it
+# is still materialized as a compile-time constant: 9! x 9 rows x 2
+# tables x 4B = 26MB, acceptable; 10! would be 290MB, not.
+MAX_SYMMETRY_ACTORS = 9
 
 
 def permutation_tables(n: int) -> Tuple[np.ndarray, np.ndarray]:
