@@ -53,6 +53,33 @@ def _sharded_sym(model, **kw):
     return checker
 
 
+
+def _bfs_states(model, cap=None):
+    """All reachable host states (dedup by hash, boundary-pruned),
+    optionally capped — the enumeration oracle several tests share."""
+    from collections import deque
+
+    states = list(model.init_states())
+    seen = {hash(s) for s in states}
+    q = deque(states)
+    acts = []
+    while q and (cap is None or len(states) < cap):
+        s = q.popleft()
+        acts.clear()
+        model.actions(s, acts)
+        for a in acts:
+            ns = model.next_state(s, a)
+            if (
+                ns is not None
+                and model.within_boundary(ns)
+                and hash(ns) not in seen
+            ):
+                seen.add(hash(ns))
+                states.append(ns)
+                q.append(ns)
+    return states
+
+
 def _raft_dup():
     return RaftModelCfg(
         server_count=3,
@@ -133,26 +160,7 @@ def test_device_group_action_matches_host():
         static_argnums=(),
     )
 
-    from collections import deque
-
-    states = list(model.init_states())
-    seen = {hash(s) for s in states}
-    q = deque(states)
-    acts = []
-    while q:
-        s = q.popleft()
-        acts.clear()
-        model.actions(s, acts)
-        for a in acts:
-            ns = model.next_state(s, a)
-            if (
-                ns is not None
-                and model.within_boundary(ns)
-                and hash(ns) not in seen
-            ):
-                seen.add(hash(ns))
-                states.append(ns)
-                q.append(ns)
+    states = _bfs_states(model)
     assert len(states) == 665
 
     perms = list(permutations(range(3)))
@@ -267,6 +275,41 @@ def test_refined_keys_match_orbit_min_partition_2pc7():
     assert (
         (rkey[:, None] == rkey[None, :]) == (mkey[:, None] == mkey[None, :])
     ).all()
+
+
+def test_generic_refine_colors_equivariance_raft():
+    """The generic PackedActorModel WL hook must be equivariant —
+    ``refine(sigma(s), sigma(colors)) == sigma(refine(s, colors))`` — or
+    same-orbit states would canonicalize differently and orbit counts
+    would over-report (the one failure mode verify-or-fallback CANNOT
+    catch). Checked directly on reachable raft states (id-references +
+    envelope flows + reverse-reference detection all in play) across
+    permutations and refinement rounds."""
+    import jax.numpy as jnp
+
+    model = _raft_dup()
+    n2o_all, o2n_all = model.packed_symmetry()
+    n = 3
+
+    states = _bfs_states(model, cap=400)
+
+    refine = jax.jit(model.packed_refine_colors)
+    apply_p = jax.jit(model.packed_apply_permutation)
+    rng = np.random.default_rng(3)
+    for s in states[::37]:
+        packed = {k: jnp.asarray(v) for k, v in model.pack_state(s).items()}
+        for k in rng.integers(0, len(n2o_all), 3):
+            n2o = jnp.asarray(n2o_all[k])
+            o2n = jnp.asarray(o2n_all[k])
+            ps = apply_p(packed, n2o, o2n)
+            colors = jnp.zeros((n,), jnp.uint32)
+            colors_p = jnp.zeros((n,), jnp.uint32)
+            for _ in range(2):
+                colors = refine(packed, colors)
+                colors_p = refine(ps, colors_p)
+                assert (
+                    np.asarray(colors_p) == np.asarray(colors)[np.asarray(n2o)]
+                ).all(), (s, np.asarray(n2o))
 
 
 def test_weak_refine_hook_falls_back_exactly():
