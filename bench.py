@@ -51,11 +51,13 @@ LEG_TIMEOUT_S = {
     "abd3o": 600,
     "raft5": 600,
     "paxos3": 900,
+    "scr4": 3600,
 }
 # Accelerator-only legs: far too slow for the CPU fallback (paxos-3c3s
-# takes ~15 min of pure compute there), so a tunnel failure skips them
-# instead of burning the fallback budget.
-ACCEL_ONLY_LEGS = {"paxos3"}
+# takes ~15 min of pure compute there; a single-copy-register-4 CPU
+# rehearsal blew a 1-hour budget, PARITY.md), so a tunnel failure skips
+# them instead of burning the fallback budget.
+ACCEL_ONLY_LEGS = {"paxos3", "scr4"}
 
 
 def log(*args):
@@ -101,6 +103,7 @@ def _leg_specs():
     from stateright_tpu.models.linearizable_register import AbdModelCfg
     from stateright_tpu.models.paxos import PaxosModelCfg
     from stateright_tpu.models.raft import RaftModelCfg
+    from stateright_tpu.models.single_copy_register import SingleCopyModelCfg
     from stateright_tpu.models.two_phase_commit import TwoPhaseSys
 
     return {
@@ -157,6 +160,22 @@ def _leg_specs():
             spawn=dict(frontier_capacity=1 << 11, table_capacity=1 << 17),
             expected=46_516,
         ),
+        # The reference bench-suite row `single-copy-register check 4`
+        # (/root/reference/bench.sh:29): 4 register clients against one
+        # non-replicated server, linearizability history checked on device
+        # per wave. No pinned oracle yet — a CPU rehearsal exceeded a
+        # 1-hour budget (PARITY.md), so the leg is accelerator-only and
+        # the first completed device run pins the count.
+        "scr4": dict(
+            model=lambda: SingleCopyModelCfg(
+                4, 1, envelope_capacity=12
+            ).into_model(),
+            spawn=dict(
+                frontier_capacity=1 << 12,
+                table_capacity=1 << 22,
+                drain_log_factor=32,
+            ),
+        ),
         # BASELINE.md asks for time-to-counterexample: raft-5's
         # ``eventually "stable leader"`` is intentionally falsifiable, so
         # this leg runs the model with ONLY that property retained and
@@ -182,18 +201,21 @@ def _run_leg(leg: str, pin_cpu: bool):
     """Child entry: runs one leg, prints its result dict as a JSON line."""
     import jax
 
-    # Persistent compilation cache: every leg is its own subprocess, so
-    # without this each leg recompiles shapes the previous legs (or the
-    # previous round) already built — through the device tunnel that is
-    # 30-40s per jitted shape. Warmup accounting stays honest: cache hits
-    # simply shrink warmup_seconds.
-    from stateright_tpu.utils.compile_cache import enable_persistent_cache
-
-    enable_persistent_cache()
     if pin_cpu:
         # sitecustomize forces jax_platforms=axon,cpu via jax.config, which
         # overrides the JAX_PLATFORMS env var — re-pin through the config.
         jax.config.update("jax_platforms", "cpu")
+    # Persistent compilation cache: every leg is its own subprocess, so
+    # without this each leg recompiles shapes the previous legs (or the
+    # previous round) already built — through the device tunnel that is
+    # 30-40s per jitted shape. Warmup accounting stays honest: cache hits
+    # simply shrink warmup_seconds. MUST come after the platform pin: the
+    # cache directory is keyed on the resolved platform line-up, so
+    # enabling first would file this process's artifacts under the wrong
+    # target (the r03 cross-target SIGILL-risk warning).
+    from stateright_tpu.utils.compile_cache import enable_persistent_cache
+
+    enable_persistent_cache()
     device = jax.devices()[0]
     log(f"[{leg}] device: {device.platform} ({device})")
     out = {"device": device.platform}
@@ -260,6 +282,65 @@ def _run_leg(leg: str, pin_cpu: bool):
     print(json.dumps(out))
 
 
+def _run_breakdown(leg: str, pin_cpu: bool):
+    """Child entry: per-wave stage cost attribution for one leg's model
+    (VERDICT r03 #1b — the judgeability half of the TPU datapoint). Runs
+    AFTER the timed legs so its stage-split jits never pollute leg
+    timings; prints one JSON line."""
+    import jax
+
+    if pin_cpu:
+        jax.config.update("jax_platforms", "cpu")
+    # After the pin — the cache dir is keyed on the platform line-up.
+    from stateright_tpu.utils.compile_cache import enable_persistent_cache
+
+    enable_persistent_cache()
+    from stateright_tpu.checker.breakdown import measure_wave_breakdown
+
+    spec = _leg_specs()[leg]
+    out = measure_wave_breakdown(
+        spec["model"](),
+        frontier_capacity=spec["spawn"].get("frontier_capacity", 1 << 11),
+        table_capacity=spec["spawn"].get("table_capacity", 1 << 20),
+    )
+    print(json.dumps(out))
+
+
+def _probe_log_summary():
+    """Summarizes the standing sentinel's probe log (scripts/
+    tpu_sentinel.sh) so a CPU-fallback bench still carries proof of
+    continuous tunnel attempts."""
+    import os
+
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "PROBE_LOG.jsonl")
+    if not os.path.exists(path):
+        return None
+    attempts = ok = 0
+    first = last = None
+    last_ok = None
+    with open(path) as f:
+        for raw in f:
+            try:
+                rec = json.loads(raw)
+            except json.JSONDecodeError:
+                continue
+            attempts += 1
+            if first is None:
+                first = rec.get("ts")
+            last = rec.get("ts")
+            if rec.get("ok"):
+                ok += 1
+                last_ok = rec.get("ts")
+    return {
+        "attempts": attempts,
+        "ok": ok,
+        "first": first,
+        "last": last,
+        "last_ok": last_ok,
+    }
+
+
 def _leg_subprocess(leg: str, pin_cpu: bool, extra=()):
     """Runs one leg in a child; returns its result dict or None."""
     argv = [sys.executable, __file__, "--leg", leg, *extra]
@@ -287,6 +368,10 @@ def _leg_subprocess(leg: str, pin_cpu: bool, extra=()):
 
 
 def main():
+    if "--breakdown" in sys.argv:
+        return _run_breakdown(
+            sys.argv[sys.argv.index("--breakdown") + 1], "--cpu" in sys.argv
+        )
     if "--leg" in sys.argv:
         return _run_leg(
             sys.argv[sys.argv.index("--leg") + 1], "--cpu" in sys.argv
@@ -294,7 +379,9 @@ def main():
 
     on_accel = _accelerator_usable()
     results = {}
-    for i, leg in enumerate(("2pc", "paxos", "ilock", "abd3o", "raft5", "paxos3")):
+    for i, leg in enumerate(
+        ("2pc", "paxos", "ilock", "abd3o", "raft5", "paxos3", "scr4")
+    ):
         if not on_accel and i > 0:
             # The tunnel recovers on hour scales; a single cheap re-probe
             # per leg means a mid-bench recovery isn't wasted. (Skipped on
@@ -351,13 +438,28 @@ def main():
         "value": round(primary["rate"], 1),
         "unit": "unique states/sec",
         "vs_baseline": round(primary["rate"] / primary["host_rate"], 3),
-        "baseline": "host BfsChecker (Python), same model, capped run",
+        # The denominator is this repo's own pure-Python host BfsChecker —
+        # NOT the reference's Rust engine. The reference publishes no
+        # absolute numbers (BASELINE.md) and this image has no Rust
+        # toolchain to measure one, so the only defensible reference-engine
+        # figure is the one implied by the driver's own north-star
+        # arithmetic: >=50M states/s at >=20x the 32-thread Rust
+        # BfsChecker implies ~2.5M states/s for the Rust engine on paxos.
+        "baseline": "host BfsChecker (pure Python), same model, capped run"
+        " — NOT the reference Rust engine",
+        "ref_engine_estimate": {
+            "states_per_sec": 2_500_000,
+            "basis": "implied by BASELINE.md north-star (50M/s at 20x the"
+            " 32-thread Rust BfsChecker); not measured — no Rust"
+            " toolchain on this image, reference publishes no figures."
+            " vs_baseline does NOT claim a win over the Rust engine.",
+        },
         "unique_states": primary["unique"],
         "wall_s": round(primary["wall_s"], 2),
         "warmup_s": round(primary["warmup_s"], 2),
         "device": primary["device"],
     }
-    for leg in ("paxos", "ilock", "abd3o", "raft5", "paxos3"):
+    for leg in ("paxos", "ilock", "abd3o", "raft5", "paxos3", "scr4"):
         if leg in results:
             line[f"{leg}_rate"] = round(results[leg]["rate"], 1)
             line[f"{leg}_unique"] = results[leg]["unique"]
@@ -365,6 +467,27 @@ def main():
             line[f"{leg}_device"] = results[leg]["device"]
             if "ttc_s" in results[leg]:
                 line[f"{leg}_ttc_s"] = round(results[leg]["ttc_s"], 2)
+
+    # Judgeability (VERDICT r03 #1b): per-wave stage attribution + roofline
+    # for the headline leg and the predicate-heavy ABD leg, run after the
+    # timed legs. Each is its own subprocess so a wedged breakdown costs
+    # its own timeout only.
+    for leg in ("2pc", "abd3o"):
+        argv = [sys.executable, __file__, "--breakdown", leg]
+        if not on_accel:
+            argv.append("--cpu")
+        try:
+            r = subprocess.run(argv, timeout=600, stdout=subprocess.PIPE)
+            if r.returncode == 0 and r.stdout.strip():
+                line[f"breakdown_{leg}"] = json.loads(
+                    r.stdout.decode().strip().splitlines()[-1]
+                )
+        except (subprocess.TimeoutExpired, json.JSONDecodeError):
+            log(f"[breakdown {leg}] failed or timed out")
+
+    probes = _probe_log_summary()
+    if probes is not None:
+        line["tunnel_probe_log"] = probes
     print(json.dumps(line))
 
 

@@ -11,20 +11,42 @@ must not silently diverge from the reference.
 
 ``CheckerBuilder.complete_liveness()`` adds the missing half as a
 post-pass: for every ``eventually`` property still without a discovery,
-search for a **lasso** — a path from an initial state that never satisfies
-the condition and closes a cycle. Any infinite counterexample path in a
-finite space is exactly such a lasso, and any path that touches a
-satisfying state is no counterexample, so the search runs entirely inside
-the condition-false region: a host DFS from condition-false initial
-states, following only condition-false successors, looking for a back
-edge to a state on the current DFS path (gray). The resulting discovery
-is a finite certificate: a concrete path whose final state revisits an
-earlier state with the condition false at every step.
+search the condition-false region for a maximal path that never satisfies
+the condition. In a finite space such a path is either a **lasso** — a
+condition-false path from an initial state that closes a cycle — or a
+condition-false path ending at a **terminal** state (no within-boundary
+successors at all). The second shape matters even though the default
+checkers nominally handle terminal states: their eventually-bits are
+merged at DAG joins (the first reference FIXME), so a terminal
+counterexample reached second via a join is masked; the post-pass
+re-derives it from scratch. Any path that touches a satisfying state is
+no counterexample, so the search runs entirely inside the
+condition-false region: a host DFS from condition-false initial states,
+following only condition-false successors, returning on a back edge to a
+state on the current DFS path (gray) — the lasso certificate, a concrete
+path whose final state revisits an earlier one — or on reaching a state
+with no successors in the full model — the maximal-path certificate.
+Together the two shapes are exhaustive, so the pass is exact: it finds a
+counterexample iff one exists within the boundary.
 
 The pass is self-contained (it re-expands on the host model; it does not
 need the checker's visited set), exact for finite boundaries, and costs
 O(size of the reachable condition-false region) in host time and memory —
 which is why it is opt-in rather than always-on.
+
+**Practical scale ceiling.** The O(region) bound is the *certify-absence*
+cost: when no counterexample exists the DFS must exhaust the region, at
+one host ``actions``+``next_state`` expansion per false state (≈ the host
+``BfsChecker``'s per-state cost, thousands-to-tens-of-thousands of
+states/s depending on the model — ``tests/test_liveness.py`` pins a
+100K-state absence certification in the fast lane). When a counterexample
+EXISTS, depth-first order typically finds a certificate after a tiny
+fraction of the region: raft-3 (lossy, the ``check-live`` CLI config)
+yields its stable-leader lasso in well under a second. Budget for the
+region-exhaust case when opting in at raft-5 scale (a ~735K-state false
+region ≈ minutes of single-threaded host time); the device checkers'
+parent-pointer store cannot shortcut this — it records tree edges only,
+and cycle detection needs the full edge relation.
 """
 
 from __future__ import annotations
@@ -42,25 +64,37 @@ __all__ = [
 
 
 def find_eventually_lasso(model, prop: Property) -> Optional[Path]:
-    """A lasso counterexample for one ``eventually`` property, or None.
+    """A counterexample for one ``eventually`` property, or None.
 
     Iterative DFS over the condition-false region with white/gray/black
-    coloring; a successor that is gray closes the cycle. States must be
+    coloring. Two certificate shapes, exhaustive for finite boundaries:
+    a successor that is gray closes a cycle (lasso), and a visited state
+    with no within-boundary successors in the FULL model ends a maximal
+    path (the terminal case the default checkers can mask via their
+    eventually-bit merge at DAG joins — ``bfs.py``'s parity NOTE). A
+    state whose successors all satisfy the condition is neither: every
+    maximal path through it satisfies the property. States must be
     hashable (the host checkers' standing requirement).
     """
     cond = prop.condition
 
-    def false_succs(state):
+    def expand(state):
+        """(had_any_successor, condition-false successors). The first
+        component uses the full successor set — terminality must match
+        the host BFS's notion (``bfs.py``: any action yielding a
+        non-None, within-boundary next state), not the false region's."""
         acts: List = []
         model.actions(state, acts)
+        any_within = False
+        false_succs: List = []
         for a in acts:
             ns = model.next_state(state, a)
-            if (
-                ns is not None
-                and model.within_boundary(ns)
-                and not cond(model, ns)
-            ):
-                yield a, ns
+            if ns is None or not model.within_boundary(ns):
+                continue
+            any_within = True
+            if not cond(model, ns):
+                false_succs.append((a, ns))
+        return any_within, false_succs
 
     WHITE, GRAY, BLACK = 0, 1, 2
     color: Dict = {}
@@ -70,7 +104,11 @@ def find_eventually_lasso(model, prop: Property) -> Optional[Path]:
         if color.get(init, WHITE) != WHITE:
             continue
         color[init] = GRAY
-        stack = [(init, false_succs(init))]
+        any_within, succs = expand(init)
+        if not any_within:
+            # Terminal condition-false init: a one-state maximal path.
+            return Path([(init, None)])
+        stack = [(init, iter(succs))]
         trail: List = [init]  # states on the current DFS path
         actions: List = []  # actions between them (len == len(trail) - 1)
         while stack:
@@ -87,7 +125,16 @@ def find_eventually_lasso(model, prop: Property) -> Optional[Path]:
                     return Path(steps)
                 if c == WHITE:
                     color[nxt] = GRAY
-                    stack.append((nxt, false_succs(nxt)))
+                    any_within, nsuccs = expand(nxt)
+                    if not any_within:
+                        # Terminal condition-false state: trail + the
+                        # closing edge is a maximal never-satisfying path.
+                        steps = [
+                            (s, a) for s, a in zip(trail, actions + [action])
+                        ]
+                        steps.append((nxt, None))
+                        return Path(steps)
+                    stack.append((nxt, iter(nsuccs)))
                     trail.append(nxt)
                     actions.append(action)
                     descended = True
@@ -126,9 +173,10 @@ def checker_lasso_pass(checker, done: bool, have) -> Dict[str, Path]:
 
 
 def lasso_discoveries(model, properties, have) -> Dict[str, Path]:
-    """Lasso counterexamples for every undiscovered ``eventually``
-    property. ``have`` is the checker's existing discovery-name set
-    (first-found wins; terminal-state counterexamples stay as-is)."""
+    """Counterexamples (lasso or masked-terminal maximal path) for every
+    undiscovered ``eventually`` property. ``have`` is the checker's
+    existing discovery-name set (first-found wins; counterexamples the
+    default semantics already reported stay as-is)."""
     out: Dict[str, Path] = {}
     for prop in properties:
         if prop.expectation != Expectation.EVENTUALLY:

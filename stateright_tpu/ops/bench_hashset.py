@@ -1,16 +1,20 @@
 """Micro-bench: XLA scatter-claim insert vs the Pallas tile-sweep kernel
 on the current default device. Usage::
 
-    python -m stateright_tpu.ops.bench_hashset [log2_capacity] [batch]
+    python -m stateright_tpu.ops.bench_hashset [log2_capacity] [batch] [--json]
 
 Feeds both paths identical sorted batches at the checkers' target load
 factor and prints keys/sec for each. Decides whether runs should pass
 ``hashset_impl="pallas"`` to the TPU checkers (``checker/tpu.py`` — the
 default stays "xla" until the Pallas path measures faster on hardware).
+``--json`` prints ONE machine-readable line instead (recorded in
+DEVICE_RUNS.jsonl by scripts/device_bench_run.sh so the per-backend
+winner is part of the round's bench evidence).
 """
 
 from __future__ import annotations
 
+import json
 import sys
 import time
 
@@ -21,8 +25,10 @@ import jax.numpy as jnp
 
 
 def main():
-    log2_cap = int(sys.argv[1]) if len(sys.argv) > 1 else 20
-    batch = int(sys.argv[2]) if len(sys.argv) > 2 else 1 << 15
+    args = [a for a in sys.argv[1:] if a != "--json"]
+    as_json = "--json" in sys.argv
+    log2_cap = int(args[0]) if len(args) > 0 else 20
+    batch = int(args[1]) if len(args) > 1 else 1 << 15
     cap = 1 << log2_cap
     rounds = max(1, int(cap * 0.5) // batch)  # fill to ~50% load
 
@@ -44,6 +50,7 @@ def main():
             yield jnp.asarray(hi[order]), jnp.asarray(lo[order])
 
     ones = jnp.ones((batch,), bool)
+    results = {}
 
     for name, fn in (
         ("xla", lambda t, h, l: hashset_insert(t, h, l, ones)),
@@ -72,10 +79,33 @@ def main():
         jax.block_until_ready(table)
         dt = time.perf_counter() - t0
         fresh_n = int(fresh_total)
-        print(
+        results[name] = {
+            "lanes_per_s": round(lanes / dt, 1),
+            "inserts_per_s": round(fresh_n / dt, 1),
+            "fresh": fresh_n,
+            "pending": int(pend_total),
+        }
+        out_line = (
             f"{name}: {lanes} lanes in {dt:.3f}s = {lanes/dt:,.0f} lanes/s, "
             f"{fresh_n/dt:,.0f} effective inserts/s "
             f"(fresh={fresh_n} pending={int(pend_total)})"
+        )
+        print(out_line, file=sys.stderr if as_json else sys.stdout)
+
+    if as_json:
+        print(
+            json.dumps(
+                {
+                    "device": dev.platform,
+                    "compiled": not interpret,
+                    "cap_log2": log2_cap,
+                    "batch": batch,
+                    **results,
+                    "winner": max(
+                        results, key=lambda k: results[k]["lanes_per_s"]
+                    ),
+                }
+            )
         )
 
 

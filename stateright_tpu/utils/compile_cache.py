@@ -2,26 +2,113 @@
 
 One definition of the cache location, used by tests/conftest.py,
 scripts/cpu_pin.py, and bench.py's per-leg subprocesses — a split cache
-silently loses the cross-run hits the warmup accounting depends on. The
-directory is per-uid (shared hosts must not collide on a world-writable
-path), and entries key on the HLO hash, so source changes miss naturally.
+silently loses the cross-run hits the warmup accounting depends on.
+
+Two hazards shape the location:
+
+- **Target mismatch.** XLA *loads* cached CPU executables even when the
+  recorded feature set differs from the host's — it warns ("could lead
+  to execution errors such as SIGILL", seen in BENCH_r03.json's tail)
+  rather than rejecting, verified empirically in round 4: a store-then-
+  load on the SAME box with the SAME pinning still warns, because the
+  recorded features include XLA compile *preferences*
+  (``+prefer-no-scatter``/``+prefer-no-gather``) that the host feature
+  probe never lists. Two consequences: (a) the r03 warning itself is a
+  benign false alarm inherent to every warm CPU cache load on this XLA
+  build — it cannot be silenced without forfeiting the CPU cache; (b)
+  the loader provides NO real cross-target protection, so protection
+  must come from the directory key. The directory is therefore scoped
+  by a fingerprint of the host CPU features AND the resolved JAX
+  platform line-up: artifacts compiled through the device tunnel
+  (platforms=axon,cpu) and CPU-pinned artifacts (platforms=cpu) never
+  share a key, and a different machine's CPU artifacts land elsewhere.
+  Callers must enable the cache AFTER any platform re-pin so the tag
+  sees the resolved line-up (tests/conftest.py, scripts/cpu_pin.py,
+  bench.py all do).
+- **Cache poisoning.** A world-readable predictable path under /tmp lets
+  another local user pre-create the directory and plant compiled
+  executables the victim will load. The cache now lives under the user's
+  home with mode 0700, and ``enable_persistent_cache`` verifies
+  ownership before handing the path to JAX (falling back to disabling
+  the persistent cache rather than loading untrusted artifacts).
+
+Entries key on the HLO hash within the directory, so source changes miss
+naturally.
 """
 
 from __future__ import annotations
 
+import hashlib
 import os
-import tempfile
+import platform as _platform
+import sys
+
+
+def _target_tag(platforms: str | None = None) -> str:
+    """Fingerprint of (machine arch, host CPU feature flags, requested
+    JAX platforms). Order-insensitive on the flags; stable across runs on
+    the same box with the same platform pin. ``platforms`` overrides
+    detection (tests)."""
+    feats = ""
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                # x86 exposes "flags", aarch64 "Features".
+                if line.startswith(("flags", "Features")):
+                    feats = " ".join(sorted(line.split(":", 1)[1].split()))
+                    break
+    except OSError:
+        pass
+    if platforms is None:
+        platforms = os.environ.get("JAX_PLATFORMS", "")
+        try:
+            import jax
+
+            platforms = jax.config.jax_platforms or platforms
+        except Exception:
+            pass
+    key = f"{_platform.machine()}|{feats}|{platforms}"
+    return hashlib.blake2s(key.encode(), digest_size=8).hexdigest()
 
 
 def cache_dir() -> str:
     return os.path.join(
-        tempfile.gettempdir(), f"jax_comp_cache_{os.getuid()}"
+        os.path.expanduser("~"),
+        ".cache",
+        "stateright_tpu",
+        f"jax_comp_cache_{_target_tag()}",
     )
 
 
 def enable_persistent_cache() -> None:
-    """Call after importing jax (and after any platform re-pin)."""
+    """Call after importing jax (and after any platform re-pin — the
+    platform is part of the cache key)."""
     import jax
 
-    jax.config.update("jax_compilation_cache_dir", cache_dir())
+    d = cache_dir()
+    try:
+        os.makedirs(d, mode=0o700, exist_ok=True)
+        os.chmod(d, 0o700)
+        st = os.stat(d)
+        owned = st.st_uid == os.getuid()
+    except OSError as e:
+        # chmod on a dir owned by someone else raises EPERM before the
+        # ownership check ever runs (the pre-created-dir poisoning case),
+        # and an unwritable $HOME fails makedirs — both take the disable
+        # path rather than killing the caller or loading untrusted
+        # artifacts.
+        print(
+            f"compile_cache: cannot secure {d} ({e}); "
+            "persistent cache DISABLED",
+            file=sys.stderr,
+        )
+        return
+    if not owned:
+        print(
+            f"compile_cache: {d} not owned by uid {os.getuid()}; "
+            "persistent cache DISABLED",
+            file=sys.stderr,
+        )
+        return
+    jax.config.update("jax_compilation_cache_dir", d)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)

@@ -200,3 +200,83 @@ def test_checkpoint_counts_are_coherent(tmp_path):
     )
     assert resumed.worker_error() is None
     assert resumed.unique_state_count() == 288
+
+
+def test_stale_sym_scheme_header_is_refused():
+    # Unit level (ADVICE r03): an r2-era orbit-min checkpoint (stale or
+    # absent sym_scheme tag) must not resume into the r3 WL key space,
+    # and full-group vs custom-representative schemes must never mix.
+    from stateright_tpu.checker.tpu import (
+        CUSTOM_REP_SCHEME,
+        SYM_KEY_SCHEME,
+        checkpoint_header,
+        validate_checkpoint_header,
+    )
+
+    model = TwoPhaseSys(3)
+
+    def validate(payload, sym_scheme=SYM_KEY_SCHEME):
+        validate_checkpoint_header(
+            payload, "tpu_bfs", "hint", model, model.packed_action_count(),
+            symmetry=True, sym_scheme=sym_scheme,
+        )
+
+    good = checkpoint_header(
+        "tpu_bfs", model, model.packed_action_count(), symmetry=True
+    )
+    validate(good)  # sanity: the untampered header passes
+
+    stale = dict(good, sym_scheme="orbitmin-v1")
+    with pytest.raises(ValueError, match="symmetry-key scheme"):
+        validate(stale)
+
+    absent = dict(good)
+    absent["sym_scheme"] = None
+    with pytest.raises(ValueError, match="symmetry-key scheme"):
+        validate(absent)
+
+    # Full-group checkpoint into a custom-representative checker and the
+    # reverse: refused both ways.
+    with pytest.raises(ValueError, match="symmetry-key scheme"):
+        validate(good, sym_scheme=CUSTOM_REP_SCHEME)
+    custom = dict(good, sym_scheme=CUSTOM_REP_SCHEME)
+    with pytest.raises(ValueError, match="symmetry-key scheme"):
+        validate(custom, sym_scheme=SYM_KEY_SCHEME)
+
+
+def test_tampered_sym_scheme_checkpoint_refused_on_resume(tmp_path):
+    # Integration level: a REAL symmetry checkpoint whose sym_scheme tag
+    # is rewritten to the r2 scheme must be refused by an actual resume.
+    import pickle
+
+    ckpt = tmp_path / "2pc3-sym.ckpt"
+    first = (
+        TwoPhaseSys(3)
+        .checker()
+        .symmetry()
+        .target_state_count(40)
+        .spawn_tpu_bfs(
+            frontier_capacity=32,
+            checkpoint_path=str(ckpt),
+            checkpoint_every_chunks=1,
+        )
+        .join()
+    )
+    assert first.worker_error() is None
+    assert ckpt.exists()
+
+    payload = pickle.loads(ckpt.read_bytes())
+    payload["sym_scheme"] = "orbitmin-v1"
+    ckpt.write_bytes(pickle.dumps(payload))
+
+    resumed = (
+        TwoPhaseSys(3)
+        .checker()
+        .symmetry()
+        .spawn_tpu_bfs(frontier_capacity=32, resume_from=str(ckpt))
+    )
+    with pytest.raises(RuntimeError):
+        resumed.join()
+    err = resumed.worker_error()
+    assert isinstance(err, ValueError)
+    assert "symmetry-key scheme" in str(err)

@@ -88,6 +88,47 @@ def test_device_predicate_matches_host_tester(cfg, expect_violations):
     assert (~host).any() == expect_violations
 
 
+@pytest.mark.parametrize(
+    "cfg",
+    [SingleCopyModelCfg(2, 2), AbdModelCfg(2, 2)],
+    ids=["single-copy-2c", "abd-2c"],
+)
+def test_dp_predicate_matches_lane_grid(cfg):
+    # The consumption-vector DP must agree with the superseded lane-grid
+    # enumeration (an independent oracle) on every reachable history.
+    model = cfg.into_model()
+    states = _host_reachable(model)
+    hists = np.stack(
+        [np.asarray(model.pack_state(s)["hist"]) for s in states]
+    )
+    lin = model.codec._lin
+    dp = np.asarray(jax.jit(jax.vmap(lin.predicate()))(hists))
+    lanes = np.asarray(jax.jit(jax.vmap(lin.predicate_lanes()))(hists))
+    assert (dp == lanes).all(), (
+        f"DP vs lane grid disagree on {int((dp != lanes).sum())}"
+        f"/{len(states)} states"
+    )
+
+
+@pytest.mark.slow
+def test_dp_predicate_matches_lane_grid_three_clients():
+    # C=3 crosses into multi-peer constraint vectors and 27-node DP
+    # topology; single-copy with two servers has real violations.
+    model = SingleCopyModelCfg(3, 2).into_model()
+    states = _host_reachable(model)
+    hists = np.stack(
+        [np.asarray(model.pack_state(s)["hist"]) for s in states]
+    )
+    lin = model.codec._lin
+    dp = np.asarray(jax.jit(jax.vmap(lin.predicate()))(hists))
+    lanes = np.asarray(jax.jit(jax.vmap(lin.predicate_lanes()))(hists))
+    host = np.array(
+        [s.history.serialized_history() is not None for s in states]
+    )
+    assert (dp == lanes).all() and (dp == host).all()
+    assert (~host).any()
+
+
 # -- exact device/host count parity (reference oracle counts) -----------------
 
 

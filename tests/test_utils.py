@@ -93,3 +93,31 @@ def rewrite_roundtrip(value, plan):
     from stateright_tpu.utils import rewrite_value
 
     return rewrite_value(value, plan)
+
+
+class TestCompileCache:
+    """The persistent compile cache must never serve artifacts compiled
+    for a different target (BENCH_r03's SIGILL-risk warning) or live at a
+    poisonable world-writable path."""
+
+    def test_platform_lineups_never_share_a_key(self):
+        from stateright_tpu.utils.compile_cache import _target_tag
+
+        assert _target_tag("cpu") != _target_tag("axon,cpu")
+        assert _target_tag("cpu") == _target_tag("cpu")  # stable
+
+    def test_cache_dir_under_home_and_private(self):
+        import os
+
+        from stateright_tpu.utils.compile_cache import (
+            cache_dir,
+            enable_persistent_cache,
+        )
+
+        d = cache_dir()
+        assert d.startswith(os.path.expanduser("~"))
+        assert "/tmp" not in d
+        enable_persistent_cache()  # conftest already enabled it; idempotent
+        st = os.stat(d)
+        assert st.st_uid == os.getuid()
+        assert (st.st_mode & 0o777) == 0o700
