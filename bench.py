@@ -153,9 +153,15 @@ def _leg_specs():
         # linearizability history checked on device per wave. Oracle pinned
         # by test_ordered_abd_3_clients_bench_family_parity
         # (tests/test_packed_ordered_crash.py).
+        # flow_capacity=2 is measured-exact for the 2-server quorum (see
+        # AbdModelCfg) and this leg's count assert pins it.
         "abd3o": dict(
             model=lambda: AbdModelCfg(
-                3, 2, network=Network.new_ordered(), envelope_capacity=12
+                3,
+                2,
+                network=Network.new_ordered(),
+                envelope_capacity=12,
+                flow_capacity=2,
             ).into_model(),
             spawn=dict(frontier_capacity=1 << 11, table_capacity=1 << 17),
             expected=46_516,

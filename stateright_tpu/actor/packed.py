@@ -174,6 +174,7 @@ class PackedActorModel(ActorModel, BatchableModel):
         self.codec = codec
         self.envelope_capacity = 32
         self.flow_capacity = 8
+        self.flow_pairs = None
 
     def with_envelope_capacity(self, capacity: int) -> "PackedActorModel":
         """Sets the network table's slot count (unordered networks). Must
@@ -188,6 +189,44 @@ class PackedActorModel(ActorModel, BatchableModel):
         overflow semantics to ``with_envelope_capacity``."""
         self.flow_capacity = capacity
         return self
+
+    def with_flow_pairs(self, pairs) -> "PackedActorModel":
+        """Restricts ordered-network flows to the given directed
+        ``(src, dst)`` pairs. The flow arrays and the deliver/drop action
+        grid then scale with ``len(pairs)`` instead of ``N^2`` — on the
+        3-client ordered ABD register this shrinks the packed state ~4x
+        (the N^2 flow table is ~87% of its words, mostly structurally
+        dead: register clients never message clients, nobody messages
+        itself). A device-side send outside the set behaves as a
+        zero-capacity flow (transition pruned — the same boundary
+        semantics as ``with_flow_capacity`` overflow, surfaced by the
+        exact-count parity tests); host packing of such a state raises.
+        Incompatible with full-group ``packed_symmetry`` (the pair set is
+        generally not closed under S_N; ``packed_symmetry`` raises)."""
+        pairs = [(int(a), int(b)) for a, b in pairs]
+        if len(set(pairs)) != len(pairs):
+            raise ValueError("flow_pairs contains duplicates")
+        self.flow_pairs = pairs
+        return self
+
+    def _pair_tables(self):
+        """(lookup, src, dst) numpy tables for ordered flows: ``lookup``
+        maps ``src*N + dst`` to the flow index (-1 = excluded pair);
+        ``src``/``dst`` invert it per flow index. Identity layout when
+        ``flow_pairs`` is unset."""
+        N = self._N
+        if self.flow_pairs is None:
+            idx = np.arange(N * N, dtype=np.int32)
+            return idx, (idx // N).astype(np.int32), (idx % N).astype(np.int32)
+        lookup = np.full((N * N,), -1, np.int32)
+        src = np.zeros((len(self.flow_pairs),), np.int32)
+        dst = np.zeros_like(src)
+        for k, (a, b) in enumerate(self.flow_pairs):
+            if not (0 <= a < N and 0 <= b < N):
+                raise ValueError(f"flow pair {(a, b)} out of range for N={N}")
+            lookup[a * N + b] = k
+            src[k], dst[k] = a, b
+        return lookup, src, dst
 
     # -- validation --------------------------------------------------------
 
@@ -220,7 +259,11 @@ class PackedActorModel(ActorModel, BatchableModel):
 
     @property
     def _P(self) -> int:
-        """Directed flow pairs (ordered networks): ``src * N + dst``."""
+        """Directed flow pair count (ordered networks): all ``N^2`` pairs
+        laid out as ``src * N + dst``, or the restricted ``flow_pairs``
+        list's length."""
+        if self.flow_pairs is not None:
+            return len(self.flow_pairs)
         return self._N * self._N
 
     @property
@@ -263,15 +306,23 @@ class PackedActorModel(ActorModel, BatchableModel):
 
         if self._ordered:
             Q, P = self._Q, self._P
+            lookup, _, _ = self._pair_tables()
             flow_msg = np.zeros((P, Q, W), np.uint32)
             flow_len = np.zeros((P,), np.uint32)
             for (src, dst), msgs in sys_state.network.data.items():
+                if not msgs:
+                    continue
                 if len(msgs) > Q:
                     raise ValueError(
                         f"flow {src!r}->{dst!r} holds {len(msgs)} messages; "
                         f"flow_capacity={Q} is too small"
                     )
-                p = int(src) * N + int(dst)
+                p = int(lookup[int(src) * N + int(dst)])
+                if p < 0:
+                    raise ValueError(
+                        f"flow {src!r}->{dst!r} holds messages but is not "
+                        "in flow_pairs"
+                    )
                 flow_len[p] = len(msgs)
                 for i, m in enumerate(msgs):
                     flow_msg[p, i] = codec.pack_msg(m)
@@ -353,8 +404,9 @@ class PackedActorModel(ActorModel, BatchableModel):
         if self._ordered:
             flow_msg = np.asarray(packed["flow_msg"])
             flow_len = np.asarray(packed["flow_len"])
+            _, psrc, pdst = self._pair_tables()
             for p in range(self._P):
-                src, dst = Id(p // self._N), Id(p % self._N)
+                src, dst = Id(int(psrc[p])), Id(int(pdst[p]))
                 for i in range(int(flow_len[p])):
                     network.send(
                         Envelope(src=src, dst=dst, msg=codec.unpack_msg(flow_msg[p, i]))
@@ -438,6 +490,12 @@ class PackedActorModel(ActorModel, BatchableModel):
                 "(histories carry client identities that are not "
                 "interchangeable)"
             )
+        if self.flow_pairs is not None:
+            raise NotImplementedError(
+                "full-group symmetry with restricted flow_pairs is "
+                "unsupported (the pair set is generally not closed under "
+                "actor permutations)"
+            )
         return permutation_tables(self._N)
 
     def packed_apply_permutation(self, state, new_to_old, old_to_new):
@@ -459,6 +517,13 @@ class PackedActorModel(ActorModel, BatchableModel):
         if "crashed" in state:
             out["crashed"] = state["crashed"][new_to_old]
         if self._ordered:
+            if self.flow_pairs is not None:
+                # Unreachable through the checkers (packed_symmetry
+                # raises first); direct callers get the same message.
+                raise NotImplementedError(
+                    "permutation action with restricted flow_pairs is "
+                    "unsupported"
+                )
             # Flow (a, b) of the permuted state held flow
             # (new_to_old[a], new_to_old[b]) originally; queue order is
             # preserved, so the gathered table stays positionally canonical.
@@ -553,12 +618,13 @@ class PackedActorModel(ActorModel, BatchableModel):
                     hq = hq * u(0x01000193) ^ fmsg_c[:, q, w]
                 h = jnp.where(live[:, q], hq, h)
             h = avalanche32(h ^ flen * u(0x9E3779B9))
-            a = jnp.arange(P, dtype=jnp.int32) // n
-            b = jnp.arange(P, dtype=jnp.int32) % n
+            _, psrc, pdst = self._pair_tables()
+            a = jnp.asarray(psrc)
+            b = jnp.asarray(pdst)
             out_c = avalanche32(h ^ colors[b] * u(0xCC9E2D51) + u(0x52DCE729))
             in_c = avalanche32(h ^ colors[a] * u(0x1B873593) + u(0x38495AB5))
-            out_sum = out_c.reshape(n, n).sum(axis=1, dtype=u)
-            in_sum = in_c.reshape(n, n).sum(axis=0, dtype=u)
+            out_sum = jax.ops.segment_sum(out_c, a, num_segments=n)
+            in_sum = jax.ops.segment_sum(in_c, b, num_segments=n)
         else:
             msg_c = jax.vmap(
                 lambda v: codec.rewrite_msg_ids(self, v, colors)
@@ -595,10 +661,17 @@ class PackedActorModel(ActorModel, BatchableModel):
 
         if self._ordered:
             Q = self._Q
-            p = src.astype(jnp.int32) * self._N + dst.astype(jnp.int32)
+            lookup, _, _ = self._pair_tables()
+            full = src.astype(jnp.int32) * self._N + dst.astype(jnp.int32)
+            p = jnp.asarray(lookup)[
+                jnp.clip(full, 0, self._N * self._N - 1)
+            ]
+            # Excluded pairs behave as zero-capacity flows: the send
+            # overflows and the transition is pruned (boundary semantics).
+            allowed = p >= 0
             p = jnp.clip(p, 0, self._P - 1)
             length = state["flow_len"][p]
-            ok = active & (length < Q)
+            ok = active & allowed & (length < Q)
             at = jnp.clip(length, 0, Q - 1).astype(jnp.int32)
             state = dict(state)
             state["flow_msg"] = state["flow_msg"].at[p, at].set(
@@ -607,7 +680,7 @@ class PackedActorModel(ActorModel, BatchableModel):
             state["flow_len"] = state["flow_len"].at[p].add(
                 jnp.where(ok, jnp.uint32(1), jnp.uint32(0))
             )
-            return state, active & (length >= Q)
+            return state, active & (~allowed | (length >= Q))
 
         src = src.astype(jnp.uint32)
         dst = dst.astype(jnp.uint32)
@@ -740,8 +813,9 @@ class PackedActorModel(ActorModel, BatchableModel):
         if ordered:
             flow_len = state["flow_len"]
             present = flow_len[slot] > 0
-            env_src = slot // N
-            env_dst = slot % N
+            _, psrc, pdst = self._pair_tables()
+            env_src = jnp.asarray(psrc)[slot]
+            env_dst = jnp.asarray(pdst)[slot]
             env_msg = state["flow_msg"][slot, 0]
             cnt = None
         else:

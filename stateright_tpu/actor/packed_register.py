@@ -236,3 +236,22 @@ def value_chosen_condition(model):
             ).any()
 
     return cond
+
+
+def register_flow_pairs(client_count: int, server_count: int):
+    """Directed flow pairs a register-protocol system can ever use on an
+    ordered network: every ``(src, dst)`` pair except self-pairs and
+    client-to-client — clients only message servers; servers message
+    clients and (protocol-internal, e.g. ABD replication) other servers.
+    For 3 clients / 2 servers this keeps 14 of 25 pairs, shrinking the
+    packed flow table and the deliver/drop action grid accordingly
+    (``PackedActorModel.with_flow_pairs``). Exactness is pinned by the
+    bench-family count oracles: an excluded pair that the protocol in
+    fact uses would prune transitions and fail them loudly."""
+    n = server_count + client_count
+    return [
+        (a, b)
+        for a in range(n)
+        for b in range(n)
+        if a != b and not (a >= server_count and b >= server_count)
+    ]

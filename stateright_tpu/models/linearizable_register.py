@@ -494,6 +494,17 @@ class AbdModelCfg:
         default_factory=Network.new_unordered_nonduplicating
     )
     envelope_capacity: int = 8
+    # Ordered networks only: per-flow FIFO depth. None = 2*(put_count+1)
+    # = 4, the PHASE-TOTAL bound: a client sends at most two messages per
+    # op phase-pair down any client<->server flow over its whole life
+    # (put query/update + get query/write-back), and a FIFO can never
+    # hold more than was ever sent on it. Tighter values are config-
+    # specific: 2 is measured-exact for 2 servers (quorum == all, so a
+    # server's previous reply is always consumed before the next phase;
+    # the full 2c/2s and 3c/2s spaces never exceed depth 2) and the bench
+    # leg pins it with its count oracle, but with 3+ servers a laggard
+    # replica can queue deeper — hence the safe default.
+    flow_capacity: int | None = None
 
     def into_model(self) -> ActorModel:
         model = PackedActorModel(
@@ -501,6 +512,16 @@ class AbdModelCfg:
             cfg=self,
             init_history=LinearizabilityTester(Register(DEFAULT_VALUE)),
         ).with_envelope_capacity(self.envelope_capacity)
+        if self.network.kind == "ordered":
+            # Clients never message clients and nobody messages itself:
+            # the flow table drops to the structurally reachable pairs
+            # (~4x fewer packed words + a ~2x smaller action grid on
+            # 3c/2s — the state's words were ~87% flow padding).
+            model = model.with_flow_pairs(
+                pr.register_flow_pairs(self.client_count, self.server_count)
+            ).with_flow_capacity(
+                4 if self.flow_capacity is None else self.flow_capacity
+            )
         for i in range(self.server_count):
             model.actor(AbdActor(model_peers(i, self.server_count)))
         for _ in range(self.client_count):

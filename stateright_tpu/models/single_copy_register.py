@@ -137,6 +137,17 @@ class SingleCopyModelCfg:
             cfg=self,
             init_history=LinearizabilityTester(Register(DEFAULT_VALUE)),
         ).with_envelope_capacity(self.envelope_capacity)
+        if self.network.kind == "ordered":
+            # Same structural restriction as AbdModelCfg: register clients
+            # never message clients, nobody messages itself. flow depth 2
+            # is a PHASE-TOTAL bound here (provably safe, not just
+            # measured): a single-copy client sends exactly two messages
+            # per server pair over its whole life (Put then Get, the Get
+            # only after PutOk) and the server sends exactly the two
+            # replies — a FIFO can never hold more than was ever sent.
+            model = model.with_flow_pairs(
+                pr.register_flow_pairs(self.client_count, self.server_count)
+            ).with_flow_capacity(2)
         for _ in range(self.server_count):
             model.actor(SingleCopyActor())
         for _ in range(self.client_count):

@@ -122,3 +122,87 @@ class TestPackedGuardrails:
         # Overflowing transitions were pruned: counts fall short of the
         # host oracle, which is how parity tests surface a bad capacity.
         assert checker.unique_state_count() < 665
+
+
+class TestFlowPairs:
+    """``with_flow_pairs`` (round 4): ordered-network flow tables scale
+    with the structurally reachable pair set instead of N^2."""
+
+    def test_restricted_pairs_preserve_counts(self):
+        # Host/device parity on ordered ABD IS the exactness proof: the
+        # host model is unrestricted, so any wrongly excluded pair (or a
+        # too-shallow flow) would diverge the device count.
+        from stateright_tpu.models.linearizable_register import AbdModelCfg
+
+        cfg = AbdModelCfg(2, 2, network=Network.new_ordered())
+        model = cfg.into_model()
+        assert model.flow_pairs is not None
+        assert len(model.flow_pairs) == 10  # 12 directed minus 2 c<->c
+        dev = _tpu(cfg)
+        assert dev.unique_state_count() == 620  # full host enumeration
+        dev.assert_properties()
+
+    def test_pack_state_rejects_excluded_flow(self):
+        import pytest as _pytest
+
+        from stateright_tpu.actor import Id
+        from stateright_tpu.actor.network import Envelope
+        from stateright_tpu.models.linearizable_register import AbdModelCfg
+
+        model = AbdModelCfg(2, 2, network=Network.new_ordered()).into_model()
+        state = model.init_states()[0]
+        # Forge a client->client message (pair excluded by construction).
+        state.network.send(Envelope(src=Id(2), dst=Id(3), msg=object()))
+        with _pytest.raises(ValueError, match="flow_pairs"):
+            model.pack_state(state)
+
+    def test_symmetry_with_flow_pairs_refused(self):
+        import pytest as _pytest
+
+        from stateright_tpu.models.linearizable_register import AbdModelCfg
+
+        model = AbdModelCfg(2, 2, network=Network.new_ordered()).into_model()
+        with _pytest.raises(NotImplementedError):
+            model.packed_symmetry()
+
+    def test_duplicate_pairs_rejected(self):
+        import pytest as _pytest
+
+        from stateright_tpu.models.linearizable_register import AbdModelCfg
+
+        model = AbdModelCfg(2, 2).into_model()  # unordered: pairs unset
+        assert model.flow_pairs is None
+        with _pytest.raises(ValueError, match="duplicates"):
+            model.with_flow_pairs([(0, 1), (0, 1)])
+
+    def test_ordered_single_copy_host_device_parity(self):
+        # Review finding (r4): ordered single-copy had no parity coverage
+        # for its restricted pairs + provably-safe flow depth. The host
+        # model is unrestricted, so agreement IS the exactness proof.
+        from collections import deque
+
+        from stateright_tpu.models.single_copy_register import (
+            SingleCopyModelCfg,
+        )
+
+        cfg = SingleCopyModelCfg(2, 1, network=Network.new_ordered())
+        host_model = cfg.into_model()
+        seen = set()
+        q = deque(host_model.init_states())
+        for s in q:
+            seen.add(hash(s))
+        n = 0
+        acts = []
+        while q:
+            s = q.popleft()
+            n += 1
+            acts.clear()
+            host_model.actions(s, acts)
+            for a in acts:
+                ns = host_model.next_state(s, a)
+                if ns is not None and hash(ns) not in seen:
+                    seen.add(hash(ns))
+                    q.append(ns)
+        dev = _tpu(cfg)
+        assert dev.unique_state_count() == n
+        dev.assert_properties()
