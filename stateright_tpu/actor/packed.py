@@ -983,6 +983,185 @@ class PackedActorModel(ActorModel, BatchableModel):
         ) & ~overflow
         return out, valid
 
+    def packed_expand(self, state):
+        """Per-class expansion fast path (see ``BatchableModel``): builds
+        the deliver / drop / timeout / crash candidate blocks separately,
+        in ``packed_step``'s action-id order, so each class pays only its
+        own work. ``packed_step`` (kept as the single-action path for the
+        TPU simulation checker, and as the oracle for
+        ``tests/test_packed_expand.py``) materializes all four outcome
+        variants and runs BOTH callback switches per candidate — under
+        vmap every lane executes every branch — which dominated wave time
+        on action-heavy models (raft-5: expand was 92% of the wave; drop
+        candidates here cost one FIFO/count update instead of two full
+        callback traces + four state builds)."""
+        import jax
+        import jax.numpy as jnp
+
+        self._packed_check()
+        codec = self.codec
+        N, E, T, W = self._N, self._E, self._T, codec.msg_width
+        ordered = self._ordered
+        crashes = bool(self._max_crashes)
+        msg_branches = codec.on_msg_branches(self)
+        timeout_branches = codec.on_timeout_branches(self)
+        type_arr = jnp.asarray(
+            [codec.actor_type_id(i, a) for i, a in enumerate(self.actors_list)],
+            jnp.int32,
+        )
+        D = self._P if ordered else E
+
+        def env_at(slot):
+            """(present, src, dst, msg) of deliver/drop slot ``slot``."""
+            if ordered:
+                _, psrc, pdst = self._pair_tables()
+                return (
+                    state["flow_len"][slot] > 0,
+                    jnp.asarray(psrc)[slot],
+                    jnp.asarray(pdst)[slot],
+                    state["flow_msg"][slot, 0],
+                )
+            return (
+                state["net_cnt"][slot] > 0,
+                state["net_src"][slot].astype(jnp.int32),
+                state["net_dst"][slot].astype(jnp.int32),
+                state["net_msg"][slot],
+            )
+
+        def consume(st, slot):
+            """Removes slot's message: FIFO head shift / count decrement
+            (identical to packed_step's consume_head / decrement)."""
+            st = dict(st)
+            if ordered:
+                q = st["flow_msg"][slot]
+                shifted = jnp.concatenate(
+                    [q[1:], jnp.zeros((1, W), jnp.uint32)], axis=0
+                )
+                st["flow_msg"] = st["flow_msg"].at[slot].set(shifted)
+                st["flow_len"] = st["flow_len"].at[slot].add(jnp.uint32(0) - 1)
+            else:
+                st["net_cnt"] = st["net_cnt"].at[slot].add(jnp.uint32(0) - 1)
+            return st
+
+        def crashed_at(dst):
+            if crashes:
+                return state["crashed"][jnp.clip(dst, 0, N - 1)] == 1
+            return jnp.bool_(False)
+
+        def no_op_of(changed, sends, set_bits, cancel_bits):
+            no_sends = (sends[:, 0] == codec.SEND_NONE).all()
+            return ~changed & no_sends & (set_bits == 0) & (cancel_bits == 0)
+
+        def step_deliver(slot):
+            present, env_src, env_dst, env_msg = env_at(slot)
+            actor = jnp.clip(env_dst, 0, N - 1)
+            row = state["rows"][actor]
+            row_new, sends, set_bits, cancel_bits, changed = jax.lax.switch(
+                type_arr[actor],
+                [
+                    (lambda r, a, s, m, fn=fn: fn(a, r, s, m))
+                    for fn in msg_branches
+                ],
+                row,
+                actor,
+                env_src,
+                env_msg,
+            )
+            is_no_op = no_op_of(changed, sends, set_bits, cancel_bits)
+            out = dict(state)
+            if codec.history_width:
+                out["hist"] = codec.history_on_deliver(
+                    self, state["hist"], env_src, env_dst, env_msg
+                )
+            if ordered or not self._dup:
+                out = consume(out, slot)
+            # Ordered no-op deliveries consume the message but apply no
+            # other effect (host skips the callback result entirely).
+            row_eff = jnp.where(is_no_op, row, row_new)
+            sends_eff = jnp.where(
+                is_no_op, jnp.full_like(sends, codec.SEND_NONE), sends
+            )
+            set_eff = jnp.where(is_no_op, jnp.uint32(0), set_bits)
+            cancel_eff = jnp.where(is_no_op, jnp.uint32(0), cancel_bits)
+            out, ov = self._apply_callback(
+                out, actor, row_eff, sends_eff, set_eff, cancel_eff
+            )
+            valid = (
+                present
+                & (env_dst < N)
+                & ~crashed_at(env_dst)
+                & (jnp.bool_(True) if ordered else ~is_no_op)
+                & ~ov
+            )
+            return out, valid
+
+        def step_drop(slot):
+            present, _, _, _ = env_at(slot)
+            out = dict(state)
+            if ordered:
+                out = consume(out, slot)
+            elif self._dup:
+                out["net_cnt"] = state["net_cnt"].at[slot].set(jnp.uint32(0))
+            else:
+                out = consume(out, slot)
+            return out, present
+
+        def step_timeout(k):
+            t_actor = k // T
+            t_bit = (k % T).astype(jnp.uint32)
+            row = state["rows"][t_actor]
+            row_new, sends, set_bits, cancel_bits, changed = jax.lax.switch(
+                type_arr[t_actor],
+                [
+                    (lambda r, a, b, fn=fn: fn(a, r, b))
+                    for fn in timeout_branches
+                ],
+                row,
+                t_actor,
+                t_bit,
+            )
+            renews_only = (
+                ~changed
+                & (sends[:, 0] == codec.SEND_NONE).all()
+                & (cancel_bits == 0)
+                & (set_bits == (jnp.uint32(1) << t_bit))
+            )
+            timer_set = (
+                (state["timers"][t_actor] >> t_bit) & jnp.uint32(1)
+            ) == 1
+            out, ov = self._apply_callback(
+                dict(state), t_actor, row_new, sends, set_bits, cancel_bits,
+                fired_bit=t_bit,
+            )
+            return out, timer_set & ~renews_only & ~ov
+
+        def step_crash(i):
+            out = dict(state)
+            out["crashed"] = state["crashed"].at[i].set(jnp.uint32(1))
+            out["timers"] = state["timers"].at[i].set(jnp.uint32(0))
+            valid = (state["crashed"].sum() < jnp.uint32(self._max_crashes)) & (
+                state["crashed"][i] == 0
+            )
+            return out, valid
+
+        slots = jnp.arange(D, dtype=jnp.int32)
+        parts = [jax.vmap(step_deliver)(slots)]
+        if self._lossy_network:
+            parts.append(jax.vmap(step_drop)(slots))
+        if T:
+            parts.append(
+                jax.vmap(step_timeout)(jnp.arange(N * T, dtype=jnp.int32))
+            )
+        if crashes:
+            parts.append(
+                jax.vmap(step_crash)(jnp.arange(N, dtype=jnp.int32))
+            )
+        cand = jax.tree_util.tree_map(
+            lambda *xs: jnp.concatenate(xs, axis=0), *[p[0] for p in parts]
+        )
+        valid = jnp.concatenate([p[1] for p in parts])
+        return cand, valid
+
     def packed_conditions(self):
         self._packed_check()
         conds = self.codec.packed_conditions(self)

@@ -98,10 +98,7 @@ def measure_wave_breakdown(
     fp_fn = lambda s: fingerprint_state(model.packed_fingerprint_view(s))  # noqa: E731
 
     def expand(states, mask):
-        aids = jnp.arange(A, dtype=jnp.int32)
-        cand, cvalid = jax.vmap(
-            lambda s: jax.vmap(lambda a: model.packed_step(s, a))(aids)
-        )(states)
+        cand, cvalid = jax.vmap(model.packed_expand)(states)
         cvalid = cvalid & mask[:, None]
         cvalid = cvalid & jax.vmap(jax.vmap(model.packed_within_boundary))(cand)
         return cand, cvalid

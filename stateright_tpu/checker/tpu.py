@@ -591,11 +591,9 @@ class TpuBfsChecker(Checker):
                 cond_vals[pi], ebits_after & ~jnp.uint32(1 << b), ebits_after
             )
 
-        # Expand the F × A action grid.
-        aids = jnp.arange(A, dtype=jnp.int32)
-        cand, cvalid = jax.vmap(
-            lambda s: jax.vmap(lambda a: model.packed_step(s, a))(aids)
-        )(states)
+        # Expand the F × A action grid (packed_expand: per-class fast
+        # path where the model provides one, else vmap of packed_step).
+        cand, cvalid = jax.vmap(model.packed_expand)(states)
         cvalid = cvalid & eval_mask[:, None]
         cvalid = cvalid & jax.vmap(jax.vmap(model.packed_within_boundary))(cand)
         generated = cvalid.sum(dtype=jnp.int32)

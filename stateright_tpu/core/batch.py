@@ -89,6 +89,32 @@ class BatchableModel:
         """
         raise NotImplementedError
 
+    def packed_expand(
+        self, state: PackedState
+    ) -> Tuple[PackedState, jax.Array]:
+        """All ``packed_action_count()`` candidates of one state, stacked
+        along a leading action axis: ``state -> (candidates, valid)``.
+
+        The checkers' wave kernels call THIS (vmapped over the frontier),
+        not ``packed_step`` — the default below is exactly a vmap of
+        ``packed_step`` over the action axis, but models whose actions
+        fall into structurally different classes can override it with
+        specialized per-class expansion. Under vmap, ``lax.cond``/
+        ``lax.switch`` inside a generic step execute EVERY branch for
+        every lane, so a step that dispatches over K action classes pays
+        all K class bodies per candidate; a per-class expansion pays each
+        body only on its own class's slice of the grid
+        (``PackedActorModel.packed_expand`` — 92% of the raft-5 wave was
+        this overhead). Candidate order must match ``packed_step``'s
+        action ids; equivalence on valid lanes is pinned by
+        ``tests/test_packed_expand.py``.
+        """
+        import jax
+        import jax.numpy as jnp
+
+        aids = jnp.arange(self.packed_action_count(), dtype=jnp.int32)
+        return jax.vmap(lambda a: self.packed_step(state, a))(aids)
+
     def packed_conditions(self) -> List[Callable[[PackedState], jax.Array]]:
         """Traceable predicates aligned with ``properties()`` (same order).
 

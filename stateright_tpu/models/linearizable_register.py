@@ -494,16 +494,17 @@ class AbdModelCfg:
         default_factory=Network.new_unordered_nonduplicating
     )
     envelope_capacity: int = 8
-    # Ordered networks only: per-flow FIFO depth. None = 2*(put_count+1)
-    # = 4, the PHASE-TOTAL bound: a client sends at most two messages per
-    # op phase-pair down any client<->server flow over its whole life
-    # (put query/update + get query/write-back), and a FIFO can never
-    # hold more than was ever sent on it. Tighter values are config-
-    # specific: 2 is measured-exact for 2 servers (quorum == all, so a
-    # server's previous reply is always consumed before the next phase;
-    # the full 2c/2s and 3c/2s spaces never exceed depth 2) and the bench
-    # leg pins it with its count oracle, but with 3+ servers a laggard
-    # replica can queue deeper — hence the safe default.
+    # Ordered networks only: per-flow FIFO depth. None picks 2 for
+    # 2-server configs — measured-exact there (quorum == all servers, so
+    # every reply drains before the client's next phase; the full 2c/2s
+    # and 3c/2s spaces never exceed depth 2, and the count oracles pin
+    # it) — and the legacy 8 otherwise: with 3+ servers the quorum can
+    # complete ops without a laggard replica, whose server->server
+    # replication FIFO then accumulates ~2 messages per coordinated op
+    # (4c/3s reaches depth 5 within 22K states), so NO small bound is
+    # protocol-safe. Either way the capacity is a modeling boundary:
+    # device-side overflow prunes the transition silently, and only a
+    # host-parity / pinned-count check certifies a given value exact.
     flow_capacity: int | None = None
 
     def into_model(self) -> ActorModel:
@@ -517,11 +518,13 @@ class AbdModelCfg:
             # the flow table drops to the structurally reachable pairs
             # (~4x fewer packed words + a ~2x smaller action grid on
             # 3c/2s — the state's words were ~87% flow padding).
+            if self.flow_capacity is not None:
+                depth = self.flow_capacity
+            else:
+                depth = 2 if self.server_count == 2 else 8
             model = model.with_flow_pairs(
                 pr.register_flow_pairs(self.client_count, self.server_count)
-            ).with_flow_capacity(
-                4 if self.flow_capacity is None else self.flow_capacity
-            )
+            ).with_flow_capacity(depth)
         for i in range(self.server_count):
             model.actor(AbdActor(model_peers(i, self.server_count)))
         for _ in range(self.client_count):

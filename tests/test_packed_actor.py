@@ -190,7 +190,7 @@ class TestFlowPairs:
         seen = set()
         q = deque(host_model.init_states())
         for s in q:
-            seen.add(hash(s))
+            seen.add(s)
         n = 0
         acts = []
         while q:
@@ -200,9 +200,21 @@ class TestFlowPairs:
             host_model.actions(s, acts)
             for a in acts:
                 ns = host_model.next_state(s, a)
-                if ns is not None and hash(ns) not in seen:
-                    seen.add(hash(ns))
+                if ns is not None and ns not in seen:
+                    seen.add(ns)
                     q.append(ns)
         dev = _tpu(cfg)
         assert dev.unique_state_count() == n
         dev.assert_properties()
+
+    def test_multi_server_ordered_abd_keeps_conservative_depth(self):
+        # Review finding (r4): with 3+ servers the quorum can complete
+        # ops while a laggard replica's server->server FIFO accumulates
+        # (4c/3s reaches depth 5 within 22K states), so only the
+        # 2-server quorum==all case gets the measured-exact depth 2.
+        from stateright_tpu.models.linearizable_register import AbdModelCfg
+
+        multi = AbdModelCfg(4, 3, network=Network.new_ordered()).into_model()
+        assert multi.flow_capacity == 8
+        two = AbdModelCfg(3, 2, network=Network.new_ordered()).into_model()
+        assert two.flow_capacity == 2
