@@ -230,6 +230,16 @@ def _run_leg(leg: str, pin_cpu: bool):
     if leg not in specs:
         raise ValueError(f"unknown leg {leg!r} (have: {sorted(specs)})")
     spec = specs[leg]
+    if "--dedup" in sys.argv:
+        spec["spawn"]["wave_dedup"] = sys.argv[sys.argv.index("--dedup") + 1]
+    elif device.platform == "cpu":
+        # Measured on the CPU backend: XLA's single-threaded lax.sort
+        # dominates wide waves (2pc-7 steady 26.8K -> 61K/s with the
+        # duplicate-tolerant scatter insert). The TPU keeps the sorted
+        # sequential-probe design until the device A/B (run by
+        # scripts/device_bench_run.sh) says otherwise.
+        spec["spawn"].setdefault("wave_dedup", "scatter")
+    out["wave_dedup"] = spec["spawn"].get("wave_dedup", "sort")
     if spec.get("host_baseline") and "--no-host-baseline" not in sys.argv:
         t0 = time.time()
         host = (
@@ -304,10 +314,21 @@ def _run_breakdown(leg: str, pin_cpu: bool):
     from stateright_tpu.checker.breakdown import measure_wave_breakdown
 
     spec = _leg_specs()[leg]
+    # Attribute the SAME dedup pipeline the legs run on this backend
+    # (scatter on CPU unless overridden) — stage numbers for a pipeline
+    # the rate never executed would mislead the next round.
+    if "--dedup" in sys.argv:
+        dedup = sys.argv[sys.argv.index("--dedup") + 1]
+    else:
+        dedup = (
+            spec["spawn"].get("wave_dedup")
+            or ("scatter" if jax.devices()[0].platform == "cpu" else "sort")
+        )
     out = measure_wave_breakdown(
         spec["model"](),
         frontier_capacity=spec["spawn"].get("frontier_capacity", 1 << 11),
         table_capacity=spec["spawn"].get("table_capacity", 1 << 20),
+        wave_dedup=dedup,
     )
     print(json.dumps(out))
 

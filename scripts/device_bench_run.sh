@@ -42,6 +42,17 @@ for leg in 2pc abd3o; do
   line=$(timeout 600 python bench.py --breakdown "$leg" 2>>"${OUT%.jsonl}.err" | tail -1)
   [ -n "$line" ] && echo "{\"breakdown\": \"$leg\", \"ts\": \"$(date -u +%FT%TZ)\", \"result\": $line}" >> "$OUT"
 done
+# Dedup-mode A/B on the chip: the scatter insert beats the sorted path
+# 2.3x on CPU; whether TPU HBM prefers the sort's sequential probes is
+# an open measurement — recorded as its own entry.
+if grep '"leg": "2pc"' "$OUT" 2>/dev/null | grep -q '"device": "tpu"'; then
+  if ! grep -q '"ab": "2pc-scatter"' "$OUT" 2>/dev/null; then
+    echo "=== 2pc scatter-dedup A/B $(date -u +%FT%TZ) ===" >&2
+    line=$(timeout 900 python bench.py --leg 2pc --no-host-baseline --dedup scatter \
+           2>>"${OUT%.jsonl}.err" | tail -1)
+    [ -n "$line" ] && echo "{\"ab\": \"2pc-scatter\", \"ts\": \"$(date -u +%FT%TZ)\", \"result\": $line}" >> "$OUT"
+  fi
+fi
 # Pallas-vs-XLA insert flip-test, COMPILED on the chip (VERDICT r03 #4):
 # decides the checkers' hashset_impl default per backend.
 if ! grep '"flip_test"' "$OUT" 2>/dev/null | grep -q '"device": "tpu"'; then

@@ -31,7 +31,11 @@ import jax.numpy as jnp
 
 from ..core.batch import BatchableModel
 from ..ops.fingerprint import fingerprint_state
-from ..ops.hashset import hashset_insert, hashset_new
+from ..ops.hashset import (
+    hashset_insert,
+    hashset_insert_unsorted,
+    hashset_new,
+)
 
 _U32_MAX = jnp.uint32(0xFFFFFFFF)
 
@@ -82,6 +86,7 @@ def measure_wave_breakdown(
     table_capacity: int = 1 << 20,
     warmup_waves: int = 6,
     iters: int = 20,
+    wave_dedup: str = "sort",
 ) -> Dict:
     """Stage-split timings + cost analysis on a representative wave.
 
@@ -90,7 +95,16 @@ def measure_wave_breakdown(
     a realistic fill), then times each stage. Returns a dict of
     per-stage seconds, the fused-wave seconds, per-wave cost-analysis
     totals, and roofline attainment when the device peak is known.
+
+    ``wave_dedup`` must match the configuration being attributed
+    (``TpuBfsChecker``'s knob): "sort" measures the sort_dedup + sorted
+    insert stages; "scatter" replaces both with the single
+    duplicate-tolerant ``insert`` stage the scatter path actually runs —
+    attributing a sort the measured rate never executes would mislead
+    the next optimization round.
     """
+    if wave_dedup not in ("sort", "scatter"):
+        raise ValueError(f"wave_dedup must be 'sort' or 'scatter': {wave_dedup!r}")
     F = 1 << (frontier_capacity - 1).bit_length()
     A = model.packed_action_count()
     B = F * A
@@ -129,6 +143,9 @@ def measure_wave_breakdown(
     def insert(table, shi, slo, active):
         return hashset_insert(table, shi, slo, active)
 
+    def insert_scatter(table, chi, clo, cvalid):
+        return hashset_insert_unsorted(table, chi, clo, cvalid.reshape(B))
+
     def compact(cand, sidx, fresh):
         flat = jax.tree_util.tree_map(
             lambda x: x.reshape((B,) + x.shape[2:]), cand
@@ -148,8 +165,14 @@ def measure_wave_breakdown(
         pv = props(states, mask)
         cand, cvalid = expand(states, mask)
         chi, clo = fingerprint(cand)
-        shi, slo, sidx, active = sort_dedup(chi, clo, cvalid)
-        table, fresh, _found, _pending = insert(table, shi, slo, active)
+        if wave_dedup == "scatter":
+            table, fresh, _found, _pending = insert_scatter(
+                table, chi, clo, cvalid
+            )
+            sidx = jnp.arange(B, dtype=jnp.int32)
+        else:
+            shi, slo, sidx, active = sort_dedup(chi, clo, cvalid)
+            table, fresh, _found, _pending = insert(table, shi, slo, active)
         new_states, taken = compact(cand, sidx, fresh)
         return table, new_states, taken, pv.any()
 
@@ -158,6 +181,7 @@ def measure_wave_breakdown(
     j_fp = jax.jit(fingerprint)
     j_sort = jax.jit(sort_dedup)
     j_insert = jax.jit(insert)
+    j_insert_scatter = jax.jit(insert_scatter)
     j_compact = jax.jit(compact)
     j_fused = jax.jit(fused)
 
@@ -193,22 +217,31 @@ def measure_wave_breakdown(
     frontier_fill = float(mask.sum()) / F
     cand, cvalid = j_expand(states, mask)
     chi, clo = j_fp(cand)
-    shi, slo, sidx, active = j_sort(chi, clo, cvalid)
 
     stages = {
         "expand": (j_expand, (states, mask)),
         "properties": (j_props, (states, mask)),
         "fingerprint": (j_fp, (cand,)),
-        "sort_dedup": (j_sort, (chi, clo, cvalid)),
-        "insert": (j_insert, (table, shi, slo, active)),
-        "compact": (j_compact, (cand, sidx, active)),
     }
+    if wave_dedup == "scatter":
+        _, fresh_sc, _, _ = j_insert_scatter(table, chi, clo, cvalid)
+        stages["insert"] = (j_insert_scatter, (table, chi, clo, cvalid))
+        stages["compact"] = (
+            j_compact,
+            (cand, jnp.arange(B, dtype=jnp.int32), fresh_sc),
+        )
+    else:
+        shi, slo, sidx, active = j_sort(chi, clo, cvalid)
+        stages["sort_dedup"] = (j_sort, (chi, clo, cvalid))
+        stages["insert"] = (j_insert, (table, shi, slo, active))
+        stages["compact"] = (j_compact, (cand, sidx, active))
     out = {
         "frontier_capacity": F,
         "action_count": A,
         "frontier_fill": round(frontier_fill, 4),
         "device": jax.devices()[0].platform,
         "device_kind": jax.devices()[0].device_kind,
+        "wave_dedup": wave_dedup,
         "stages_ms": {},
         "stage_cost": {},
     }

@@ -34,7 +34,13 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
-__all__ = ["hashset_new", "hashset_insert", "hashset_contains", "MAX_PROBES"]
+__all__ = [
+    "hashset_new",
+    "hashset_insert",
+    "hashset_insert_unsorted",
+    "hashset_contains",
+    "MAX_PROBES",
+]
 
 # Probe cap per insert; lanes still unplaced after this report overflow and
 # the host grows the table. With load factor kept under ~0.6 by the checker,
@@ -103,6 +109,80 @@ def hashset_insert(
     falses = jnp.zeros((n,), dtype=bool)
     table, _r, pending, fresh, found = jax.lax.while_loop(
         cond, body, (table, jnp.int32(0), active, falses, falses)
+    )
+    return table, fresh, found, pending
+
+
+def hashset_insert_unsorted(
+    table: jax.Array,
+    key_hi: jax.Array,
+    key_lo: jax.Array,
+    active: jax.Array,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """``hashset_insert`` without the wave-unique precondition: the batch
+    may contain DUPLICATE active keys in any order, and exactly one lane
+    per distinct key reports ``fresh``.
+
+    Same-key lanes attempt the same slot; the row-window claim alone
+    cannot tell them apart (each re-reads its own key either way), so a
+    table-sized *owner ticket* scratch — scatter-min of lane ids per slot
+    — breaks the tie: a lane's claim is fresh only if its ticket
+    survived. Duplicate losers observe a key match on the next round and
+    resolve as ``found``. The scratch costs one table-shaped memset +
+    one extra scatter/gather per probe round; what it buys is dropping
+    the wave's ``lax.sort`` over the full F x A candidate grid, which
+    dominates wide waves (66% of the 2pc-7 wave at F=8192 on CPU —
+    ``checker/tpu.py`` exposes the trade as ``wave_dedup``). The sorted
+    variant keeps its nearly-sequential probe pattern and stays the
+    default until the scatter pattern is measured on TPU HBM.
+
+    ``found`` counts duplicate losers as found-in-set (indistinguishable
+    from an earlier-wave hit by design; the checkers only consume
+    ``fresh``).
+    """
+    capacity = table.shape[0] - MAX_PROBES
+    base = _home(key_hi, capacity)
+    n = key_hi.shape[0]
+    lane = jnp.arange(n, dtype=jnp.uint32)
+    owner0 = jnp.full((table.shape[0],), jnp.uint32(0xFFFFFFFF))
+
+    def cond(carry):
+        _table, _owner, r, pending, _fresh, _found = carry
+        return (r < MAX_PROBES) & pending.any()
+
+    def body(carry):
+        table, owner, r, pending, fresh, found = carry
+        idx = base + r
+        row = table[idx]
+        cur_hi, cur_lo = row[:, 0], row[:, 1]
+        empty = (cur_hi == 0) & (cur_lo == 0)
+        match = (cur_hi == key_hi) & (cur_lo == key_lo)
+        found = found | (pending & match)
+        attempt = pending & empty & ~match
+        scatter_idx = jnp.where(attempt, idx, capacity + MAX_PROBES)
+        update = jnp.stack([key_hi, key_lo], axis=-1)
+        table = table.at[scatter_idx].set(update, mode="drop")
+        row2 = table[idx]
+        key_won = attempt & (row2[:, 0] == key_hi) & (row2[:, 1] == key_lo)
+        # Ticket tie-break ONLY among lanes whose key actually landed
+        # (same-key twins): a different-key contender must not write a
+        # ticket, or the table-write winner and ticket winner could
+        # disagree and a landed key would end up with no fresh lane (a
+        # silently lost state).
+        owner = owner.at[
+            jnp.where(key_won, idx, capacity + MAX_PROBES)
+        ].min(lane, mode="drop")
+        won = key_won & (owner[idx] == lane)
+        # Duplicate losers whose key DID land resolve as found and stop;
+        # different-key losers keep probing.
+        fresh = fresh | won
+        pending = pending & ~match & ~key_won
+        found = found | (key_won & ~won)
+        return table, owner, r + 1, pending, fresh, found
+
+    falses = jnp.zeros((n,), dtype=bool)
+    table, _owner, _r, pending, fresh, found = jax.lax.while_loop(
+        cond, body, (table, owner0, jnp.int32(0), active, falses, falses)
     )
     return table, fresh, found, pending
 

@@ -305,3 +305,66 @@ def test_deep_drain_2pc8_scale_exact():
     assert checker.worker_error() is None
     assert checker.unique_state_count() == 1_745_408
     checker.assert_properties()
+
+
+class TestScatterDedup:
+    """wave_dedup='scatter' (round 4): sort-free in-wave dedup via the
+    duplicate-tolerant insert. Counts must match the sorted path exactly;
+    the incompatible/unknown configurations must refuse."""
+
+    def test_counts_match_sorted_path(self):
+        from stateright_tpu.models.two_phase_commit import TwoPhaseSys
+
+        c = (
+            TwoPhaseSys(4)
+            .checker()
+            .spawn_tpu_bfs(
+                frontier_capacity=64,
+                table_capacity=1 << 12,
+                wave_dedup="scatter",
+            )
+            .join()
+        )
+        assert c.worker_error() is None
+        assert c.unique_state_count() == 1568
+        c.assert_properties()
+
+    def test_symmetry_orbit_counts_match(self):
+        from stateright_tpu.models.two_phase_commit import TwoPhaseSys
+
+        runs = {}
+        for mode in ("sort", "scatter"):
+            c = (
+                TwoPhaseSys(4)
+                .checker()
+                .symmetry()
+                .spawn_tpu_bfs(
+                    frontier_capacity=64,
+                    table_capacity=1 << 12,
+                    wave_dedup=mode,
+                )
+                .join()
+            )
+            assert c.worker_error() is None
+            runs[mode] = c.unique_state_count()
+        assert runs["sort"] == runs["scatter"]
+
+    def test_pallas_combination_refused(self):
+        import pytest as _pytest
+
+        from stateright_tpu.models.two_phase_commit import TwoPhaseSys
+
+        with _pytest.raises(ValueError, match="incompatible"):
+            TwoPhaseSys(3).checker().spawn_tpu_bfs(
+                table_capacity=1 << 12,
+                wave_dedup="scatter",
+                hashset_impl="pallas",
+            )
+
+    def test_unknown_mode_refused(self):
+        import pytest as _pytest
+
+        from stateright_tpu.models.two_phase_commit import TwoPhaseSys
+
+        with _pytest.raises(ValueError, match="wave_dedup"):
+            TwoPhaseSys(3).checker().spawn_tpu_bfs(wave_dedup="radix")
