@@ -51,13 +51,16 @@ LEG_TIMEOUT_S = {
     "abd3o": 600,
     "raft5": 600,
     "paxos3": 900,
-    "scr4": 3600,
+    "scr4": 900,
 }
-# Accelerator-only legs: far too slow for the CPU fallback (paxos-3c3s
-# takes ~15 min of pure compute there; a single-copy-register-4 CPU
-# rehearsal blew a 1-hour budget, PARITY.md), so a tunnel failure skips
-# them instead of burning the fallback budget.
-ACCEL_ONLY_LEGS = {"paxos3", "scr4"}
+# Accelerator-only legs: far too slow for the CPU fallback, so a tunnel
+# failure skips them instead of burning the fallback budget. EMPTY since
+# round 4: the DP predicate + per-class expansion + scatter dedup
+# brought the two former members inside their CPU fallback budgets —
+# paxos3 (1.19M states) to ~350s (3,611/s, count exact) and scr4 to
+# ~137s (4,535/s) — so every leg now lands in the bench JSON on every
+# backend. The gate mechanism stays for future heavyweight legs.
+ACCEL_ONLY_LEGS = set()
 
 
 def log(*args):
@@ -168,10 +171,12 @@ def _leg_specs():
         ),
         # The reference bench-suite row `single-copy-register check 4`
         # (/root/reference/bench.sh:29): 4 register clients against one
-        # non-replicated server, linearizability history checked on device
-        # per wave. No pinned oracle yet — a CPU rehearsal exceeded a
-        # 1-hour budget (PARITY.md), so the leg is accelerator-only and
-        # the first completed device run pins the count.
+        # non-replicated server, linearizability history (the 81-node
+        # C=4 DP) checked on device per wave. Count pinned by this
+        # framework's first completed run (round 4, 137s CPU; the r03
+        # rehearsal exceeded an hour) — the reference publishes no count
+        # for this config, so the oracle guards determinism and
+        # regression, not cross-engine parity.
         "scr4": dict(
             model=lambda: SingleCopyModelCfg(
                 4, 1, envelope_capacity=12
@@ -181,6 +186,7 @@ def _leg_specs():
                 table_capacity=1 << 22,
                 drain_log_factor=32,
             ),
+            expected=400_233,
         ),
         # BASELINE.md asks for time-to-counterexample: raft-5's
         # ``eventually "stable leader"`` is intentionally falsifiable, so
@@ -230,16 +236,8 @@ def _run_leg(leg: str, pin_cpu: bool):
     if leg not in specs:
         raise ValueError(f"unknown leg {leg!r} (have: {sorted(specs)})")
     spec = specs[leg]
-    if "--dedup" in sys.argv:
-        spec["spawn"]["wave_dedup"] = sys.argv[sys.argv.index("--dedup") + 1]
-    elif device.platform == "cpu":
-        # Measured on the CPU backend: XLA's single-threaded lax.sort
-        # dominates wide waves (2pc-7 steady 26.8K -> 61K/s with the
-        # duplicate-tolerant scatter insert). The TPU keeps the sorted
-        # sequential-probe design until the device A/B (run by
-        # scripts/device_bench_run.sh) says otherwise.
-        spec["spawn"].setdefault("wave_dedup", "scatter")
-    out["wave_dedup"] = spec["spawn"].get("wave_dedup", "sort")
+    spec["spawn"]["wave_dedup"] = _dedup_for(spec, device.platform)
+    out["wave_dedup"] = spec["spawn"]["wave_dedup"]
     if spec.get("host_baseline") and "--no-host-baseline" not in sys.argv:
         t0 = time.time()
         host = (
@@ -298,6 +296,22 @@ def _run_leg(leg: str, pin_cpu: bool):
     print(json.dumps(out))
 
 
+def _dedup_for(spec, platform: str) -> str:
+    """ONE definition of the wave-dedup policy, shared by the timed legs
+    and the breakdown attribution (which must describe the same pipeline):
+    CLI ``--dedup`` override > an explicit value in the leg spec >
+    backend default. The CPU default is "scatter" — measured 2.3x on
+    2pc-7 (XLA's single-threaded lax.sort dominates wide waves there);
+    the TPU keeps the sorted sequential-probe design until the on-chip
+    A/B (scripts/device_bench_run.sh) says otherwise."""
+    if "--dedup" in sys.argv:
+        return sys.argv[sys.argv.index("--dedup") + 1]
+    explicit = spec["spawn"].get("wave_dedup")
+    if explicit is not None:
+        return explicit
+    return "scatter" if platform == "cpu" else "sort"
+
+
 def _run_breakdown(leg: str, pin_cpu: bool):
     """Child entry: per-wave stage cost attribution for one leg's model
     (VERDICT r03 #1b — the judgeability half of the TPU datapoint). Runs
@@ -314,16 +328,10 @@ def _run_breakdown(leg: str, pin_cpu: bool):
     from stateright_tpu.checker.breakdown import measure_wave_breakdown
 
     spec = _leg_specs()[leg]
-    # Attribute the SAME dedup pipeline the legs run on this backend
-    # (scatter on CPU unless overridden) — stage numbers for a pipeline
-    # the rate never executed would mislead the next round.
-    if "--dedup" in sys.argv:
-        dedup = sys.argv[sys.argv.index("--dedup") + 1]
-    else:
-        dedup = (
-            spec["spawn"].get("wave_dedup")
-            or ("scatter" if jax.devices()[0].platform == "cpu" else "sort")
-        )
+    # Attribute the SAME dedup pipeline the timed legs run on this
+    # backend — stage numbers for a pipeline the rate never executed
+    # would mislead the next round.
+    dedup = _dedup_for(spec, jax.devices()[0].platform)
     out = measure_wave_breakdown(
         spec["model"](),
         frontier_capacity=spec["spawn"].get("frontier_capacity", 1 << 11),

@@ -46,7 +46,7 @@ done
 # 2.3x on CPU; whether TPU HBM prefers the sort's sequential probes is
 # an open measurement — recorded as its own entry.
 if grep '"leg": "2pc"' "$OUT" 2>/dev/null | grep -q '"device": "tpu"'; then
-  if ! grep -q '"ab": "2pc-scatter"' "$OUT" 2>/dev/null; then
+  if ! grep '"ab": "2pc-scatter"' "$OUT" 2>/dev/null | grep -q '"device": "tpu"'; then
     echo "=== 2pc scatter-dedup A/B $(date -u +%FT%TZ) ===" >&2
     line=$(timeout 900 python bench.py --leg 2pc --no-host-baseline --dedup scatter \
            2>>"${OUT%.jsonl}.err" | tail -1)
