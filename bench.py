@@ -297,19 +297,19 @@ def _run_leg(leg: str, pin_cpu: bool):
 
 
 def _dedup_for(spec, platform: str) -> str:
-    """ONE definition of the wave-dedup policy, shared by the timed legs
-    and the breakdown attribution (which must describe the same pipeline):
-    CLI ``--dedup`` override > an explicit value in the leg spec >
-    backend default. The CPU default is "scatter" — measured 2.3x on
-    2pc-7 (XLA's single-threaded lax.sort dominates wide waves there);
-    the TPU keeps the sorted sequential-probe design until the on-chip
-    A/B (scripts/device_bench_run.sh) says otherwise."""
+    """Wave-dedup resolution shared by the timed legs and the breakdown
+    attribution (which must describe the same pipeline): CLI ``--dedup``
+    override > an explicit value in the leg spec > the library's shared
+    backend default (``checker.tpu.default_wave_dedup`` — the one place
+    the policy lives)."""
     if "--dedup" in sys.argv:
         return sys.argv[sys.argv.index("--dedup") + 1]
     explicit = spec["spawn"].get("wave_dedup")
     if explicit is not None:
         return explicit
-    return "scatter" if platform == "cpu" else "sort"
+    from stateright_tpu.checker.tpu import default_wave_dedup
+
+    return default_wave_dedup(platform)
 
 
 def _run_breakdown(leg: str, pin_cpu: bool):

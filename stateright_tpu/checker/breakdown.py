@@ -86,7 +86,7 @@ def measure_wave_breakdown(
     table_capacity: int = 1 << 20,
     warmup_waves: int = 6,
     iters: int = 20,
-    wave_dedup: str = "sort",
+    wave_dedup: str | None = None,
 ) -> Dict:
     """Stage-split timings + cost analysis on a representative wave.
 
@@ -101,8 +101,13 @@ def measure_wave_breakdown(
     insert stages; "scatter" replaces both with the single
     duplicate-tolerant ``insert`` stage the scatter path actually runs —
     attributing a sort the measured rate never executes would mislead
-    the next optimization round.
+    the next optimization round. None resolves to the same backend
+    default the checker uses (``default_wave_dedup``).
     """
+    if wave_dedup is None:
+        from .tpu import default_wave_dedup
+
+        wave_dedup = default_wave_dedup(jax.default_backend())
     if wave_dedup not in ("sort", "scatter"):
         raise ValueError(f"wave_dedup must be 'sort' or 'scatter': {wave_dedup!r}")
     F = 1 << (frontier_capacity - 1).bit_length()

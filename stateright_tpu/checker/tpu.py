@@ -366,6 +366,19 @@ def _make_key_fn(model, fp_fn, symmetry):
     return refined_keys
 
 
+def default_wave_dedup(platform: str, hashset_impl: str = "xla") -> str:
+    """THE definition of the backend wave-dedup default, shared by
+    ``TpuBfsChecker``, ``measure_wave_breakdown``, and ``bench.py``:
+    "scatter" on the CPU backend (the duplicate-tolerant unsorted insert
+    measured 2.3x on 2pc-7 — XLA's single-threaded sort dominates wide
+    waves there), "sort" elsewhere (sequential probe pattern, pending
+    the on-chip A/B) and always under the Pallas insert kernel (it
+    requires sorted batches)."""
+    if hashset_impl == "pallas" or platform != "cpu":
+        return "sort"
+    return "scatter"
+
+
 def _pow2ceil(n: int) -> int:
     return 1 << max(0, (n - 1).bit_length())
 
@@ -392,7 +405,7 @@ class TpuBfsChecker(Checker):
         drain_log_factor=8,
         pool_factor=16,
         hashset_impl="xla",
-        wave_dedup="sort",
+        wave_dedup=None,
     ):
         model = options.model
         if not isinstance(model, BatchableModel):
@@ -442,12 +455,12 @@ class TpuBfsChecker(Checker):
                     f"multiple of {TILE_ROWS} (got {table_capacity})"
                 )
         self._hashset_impl = hashset_impl
-        # In-wave dedup strategy: "sort" (lax.sort the F*A keys, uniq by
-        # adjacency, sorted insert — sequential probe pattern, the TPU
-        # default) or "scatter" (duplicate-tolerant unsorted insert, no
-        # sort at all — measured faster on the CPU backend where XLA's
-        # sort is single-threaded and dominates wide waves). The Pallas
-        # insert kernel requires sorted batches.
+        # In-wave dedup strategy; None = the shared backend default
+        # (``default_wave_dedup``).
+        if wave_dedup is None:
+            wave_dedup = default_wave_dedup(
+                jax.default_backend(), hashset_impl
+            )
         if wave_dedup not in ("sort", "scatter"):
             raise ValueError(
                 f"wave_dedup must be 'sort' or 'scatter', got {wave_dedup!r}"
