@@ -35,7 +35,26 @@ missing_legs() {
     echo "$out"
 }
 
+bench_main_running() {
+    # The full bench advertises itself; the chip is single-tenant, so a
+    # sentinel firing mid-bench would wedge both claimants. Guard
+    # against pid reuse after a crashed bench: the live process must
+    # actually BE bench.py.
+    local pidfile=/tmp/stateright_bench_main.pid pid
+    [ -f "$pidfile" ] || return 1
+    pid=$(cat "$pidfile" 2>/dev/null)
+    [ -n "$pid" ] && kill -0 "$pid" 2>/dev/null \
+        && grep -aq "bench.py" "/proc/$pid/cmdline" 2>/dev/null
+}
+
 while true; do
+    if bench_main_running; then
+        # Log the stand-down: a silent gap in the probe log would be
+        # indistinguishable from a dead sentinel.
+        echo "{\"ts\": \"$(date -u +%FT%TZ)\", \"ok\": false, \"standdown\": true}" >> "$PROBES"
+        sleep "$INTERVAL"
+        continue
+    fi
     if probe; then
         echo "{\"ts\": \"$(date -u +%FT%TZ)\", \"ok\": true}" >> "$PROBES"
         miss=$(missing_legs)
