@@ -363,7 +363,7 @@ def _probe_log_summary():
     path = os.path.join(REPO_DIR, "PROBE_LOG.jsonl")
     if not os.path.exists(path):
         return None
-    attempts = ok = 0
+    attempts = ok = standdowns = 0
     first = last = None
     last_ok = None
     with open(path) as f:
@@ -372,16 +372,22 @@ def _probe_log_summary():
                 rec = json.loads(raw)
             except json.JSONDecodeError:
                 continue
-            attempts += 1
+            if rec.get("standdown"):
+                # Liveness heartbeat while a full bench held the chip —
+                # not a tunnel attempt.
+                standdowns += 1
+            else:
+                attempts += 1
+                if rec.get("ok"):
+                    ok += 1
+                    last_ok = rec.get("ts")
             if first is None:
                 first = rec.get("ts")
             last = rec.get("ts")
-            if rec.get("ok"):
-                ok += 1
-                last_ok = rec.get("ts")
     return {
         "attempts": attempts,
         "ok": ok,
+        "standdowns": standdowns,
         "first": first,
         "last": last,
         "last_ok": last_ok,

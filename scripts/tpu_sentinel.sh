@@ -18,7 +18,7 @@ LEGS="2pc paxos3 abd3o paxos ilock raft5 scr4"
 cd "$REPO"
 
 probe() {
-    timeout 60 python -c \
+    timeout -k 10 60 python -c \
         "import jax; d = jax.devices(); print('probe-ok', d[0].platform)" \
         2>/dev/null | grep -q probe-ok
 }
