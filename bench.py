@@ -44,9 +44,14 @@ REPO_DIR = os.path.dirname(os.path.abspath(__file__))
 # Single-tenant-chip coordination with scripts/tpu_sentinel.sh /
 # device_bench_run.sh: the full bench advertises itself via the pid file
 # (the sentinel stands down), and conversely never probes the device
-# while the sentinel's device run holds its lock.
-BENCH_PID_FILE = "/tmp/stateright_bench_main.pid"
-DEVICE_RUN_LOCK = "/tmp/device_bench_run.lock"
+# while the sentinel's device run holds its lock. Both live under a
+# repo-owned 0700 runtime dir, not /tmp — predictable world-writable
+# paths let any local user squat the lock and stand the bench down
+# forever (same hazard class the compile-cache hardening closed).
+RUNTIME_DIR = os.path.join(REPO_DIR, ".runtime")
+os.makedirs(RUNTIME_DIR, mode=0o700, exist_ok=True)
+BENCH_PID_FILE = os.path.join(RUNTIME_DIR, "stateright_bench_main.pid")
+DEVICE_RUN_LOCK = os.path.join(RUNTIME_DIR, "device_bench_run.lock")
 
 RM_COUNT = 7
 EXPECTED_UNIQUE = 296_448
@@ -54,6 +59,7 @@ HOST_CAP = 30_000
 DEVICE_PROBE_TIMEOUT_S = 60
 DEVICE_PROBE_ATTEMPTS = 3
 LEG_TIMEOUT_S = {
+    "smoke": 120,
     "2pc": 720,
     "paxos": 600,
     "ilock": 300,
@@ -125,6 +131,18 @@ def _leg_specs():
     from stateright_tpu.models.two_phase_commit import TwoPhaseSys
 
     return {
+        # Device smoke leg (VERDICT r04 #1a): 2pc-5 — 8,832 states, warm in
+        # seconds — exists to bank a completed `"device": "tpu"` datapoint
+        # within the first minute of any tunnel window, BEFORE the
+        # ~25-minute headline leg gets a chance to ride the window into a
+        # wedge. Not part of the CPU bench rotation (its steady-state
+        # window is too short to be a rate claim); advisory by design.
+        "smoke": dict(
+            model=lambda: TwoPhaseSys(5),
+            spawn=dict(frontier_capacity=1 << 10, table_capacity=1 << 15),
+            expected=8_832,
+            advisory=True,
+        ),
         "2pc": dict(
             model=lambda: TwoPhaseSys(RM_COUNT),
             spawn=dict(
@@ -161,10 +179,15 @@ def _leg_specs():
         # (always-mutex; the "sum" ALWAYS property holds). Tiny space, so
         # the number is dominated by warmup — reported for config coverage,
         # with the steady-state rate computed net of warmup like the rest.
+        # 257 states is warmup-dominated: the rate swings ±70% run-to-run
+        # (4,786/s vs 2,847/s measured in round 4), so it is marked
+        # advisory (VERDICT r04 #6) — the leg exists for BASELINE.md
+        # config coverage, not as a throughput claim.
         "ilock": dict(
             model=lambda: IncrementLock(4),
             spawn=dict(frontier_capacity=1 << 6, table_capacity=1 << 10),
             expected=257,
+            advisory=True,
         ),
         # BASELINE.md measurement config: `linearizable-register check 3
         # ordered` — 3 ABD clients / 2 servers over per-pair FIFO flows,
@@ -245,7 +268,13 @@ def _run_leg(leg: str, pin_cpu: bool):
     enable_persistent_cache()
     device = jax.devices()[0]
     log(f"[{leg}] device: {device.platform} ({device})")
-    out = {"device": device.platform}
+    # Measurement-regime label (VERDICT r04 #7): in-bench legs share the
+    # box with sibling legs' host baselines and caches and measure 4-15%
+    # below solo runs; the number should say which regime produced it.
+    out = {
+        "device": device.platform,
+        "run_mode": "in_bench" if "--in-bench" in sys.argv else "solo",
+    }
 
     specs = _leg_specs()
     if leg not in specs:
@@ -272,8 +301,52 @@ def _run_leg(leg: str, pin_cpu: bool):
     t0 = time.time()
     builder = spec["model"]().checker()
     builder = spec.get("builder", lambda b: b)(builder)
-    checker = builder.spawn_tpu_bfs(**spec["spawn"]).join()
-    dt = time.time() - t0
+    # Partial-progress sidecar (VERDICT r04 #1c): a tunnel wedge kills
+    # this process via the caller's timeout; the sidecar preserves the
+    # last observed unique-count/elapsed pair so device_bench_run.sh can
+    # record a partial rate instead of `result: null`. Cleared up front —
+    # a stale file from a previous killed run must never be salvaged as
+    # THIS run's progress — and removed in the finally (join() re-raises
+    # worker errors, and an errored run's sidecar is equally stale).
+    progress_path = os.path.join(RUNTIME_DIR, f"leg_{leg}.progress.json")
+    try:
+        os.remove(progress_path)
+    except OSError:
+        pass
+    checker = builder.spawn_tpu_bfs(**spec["spawn"])
+    try:
+        while not checker.is_done():
+            time.sleep(2.0)
+            try:
+                # Atomic tmp+replace: timeout's SIGKILL mid-write must not
+                # leave truncated JSON for the shell to splice verbatim
+                # into DEVICE_RUNS.jsonl.
+                tmp = progress_path + ".tmp"
+                # Keys deliberately avoid "leg"/"device": the shell-side
+                # completed-leg checks are line-based greps for
+                # `"leg": X` + `"device": "tpu"`, and a salvaged partial
+                # spliced onto one JSONL line must never match them.
+                with open(tmp, "w") as f:
+                    json.dump(
+                        {
+                            "partial_of": leg,
+                            "on_device": device.platform,
+                            "unique_so_far": checker.unique_state_count(),
+                            "elapsed_s": round(time.time() - t0, 2),
+                            "partial": True,
+                        },
+                        f,
+                    )
+                os.replace(tmp, progress_path)
+            except OSError:
+                pass
+        checker.join()
+        dt = time.time() - t0
+    finally:
+        try:
+            os.remove(progress_path)
+        except OSError:
+            pass
     err = checker.worker_error()
     if err is not None:
         raise err
@@ -293,6 +366,9 @@ def _run_leg(leg: str, pin_cpu: bool):
         warmup_s=warmup,
         rate=unique / max(dt - warmup, 1e-9),
     )
+    if spec.get("advisory"):
+        # Sub-second steady windows are not rate claims (VERDICT r04 #6).
+        out["advisory"] = True
     want = spec.get("expect_discovery")
     if want is not None:
         path = checker.discoveries().get(want)
@@ -317,8 +393,9 @@ def _dedup_for(spec, platform: str) -> str:
     override > an explicit value in the leg spec > the library's shared
     backend default (``checker.tpu.default_wave_dedup`` — the one place
     the policy lives)."""
-    if "--dedup" in sys.argv:
-        return sys.argv[sys.argv.index("--dedup") + 1]
+    override = _parse_dedup_flag()
+    if override is not None:
+        return override
     explicit = spec["spawn"].get("wave_dedup")
     if explicit is not None:
         return explicit
@@ -394,9 +471,34 @@ def _probe_log_summary():
     }
 
 
+def _parse_dedup_flag():
+    """The one place ``--dedup`` is parsed (both forms, explicit error on
+    a missing value — a trailing ``--dedup`` must not IndexError the
+    whole bench and ``--dedup=X`` must not silently no-op)."""
+    for i, arg in enumerate(sys.argv):
+        if arg == "--dedup":
+            if i + 1 >= len(sys.argv) or sys.argv[i + 1].startswith("--"):
+                raise SystemExit("--dedup requires a value (sort|scatter)")
+            return sys.argv[i + 1]
+        if arg.startswith("--dedup="):
+            return arg.split("=", 1)[1]
+    return None
+
+
+def _dedup_override_args():
+    """A parent-level ``--dedup X`` must reach every child (legs and
+    breakdowns) or the override silently no-ops while appearing accepted
+    (advisor finding, round 4)."""
+    value = _parse_dedup_flag()
+    return ("--dedup", value) if value is not None else ()
+
+
 def _leg_subprocess(leg: str, pin_cpu: bool, extra=()):
     """Runs one leg in a child; returns its result dict or None."""
-    argv = [sys.executable, __file__, "--leg", leg, *extra]
+    argv = [
+        sys.executable, __file__, "--leg", leg, "--in-bench",
+        *_dedup_override_args(), *extra,
+    ]
     # CPU-pinned fallbacks get extra headroom: they exist so the bench
     # always emits a number, and a slow host must not be killed like a
     # wedged tunnel.
@@ -559,12 +661,15 @@ def _main_benched():
         "warmup_s": round(primary["warmup_s"], 2),
         "device": primary["device"],
     }
+    line["run_mode"] = primary.get("run_mode", "in_bench")
     for leg in ("paxos", "ilock", "abd3o", "raft5", "paxos3", "scr4"):
         if leg in results:
             line[f"{leg}_rate"] = round(results[leg]["rate"], 1)
             line[f"{leg}_unique"] = results[leg]["unique"]
             line[f"{leg}_wall_s"] = round(results[leg]["wall_s"], 2)
             line[f"{leg}_device"] = results[leg]["device"]
+            if results[leg].get("advisory"):
+                line[f"{leg}_advisory"] = True
             if "ttc_s" in results[leg]:
                 line[f"{leg}_ttc_s"] = round(results[leg]["ttc_s"], 2)
 
@@ -572,8 +677,11 @@ def _main_benched():
     # for the headline leg and the predicate-heavy ABD leg, run after the
     # timed legs. Each is its own subprocess so a wedged breakdown costs
     # its own timeout only.
-    for leg in ("2pc", "abd3o"):
-        argv = [sys.executable, __file__, "--breakdown", leg]
+    for leg in ("2pc", "abd3o", "paxos3"):
+        argv = [
+            sys.executable, __file__, "--breakdown", leg,
+            *_dedup_override_args(),
+        ]
         if not on_accel:
             argv.append("--cpu")
         try:

@@ -12,8 +12,11 @@
 REPO=/root/repo
 PROBES="$REPO/PROBE_LOG.jsonl"
 RUNS="$REPO/DEVICE_RUNS.jsonl"
-INTERVAL=${SENTINEL_INTERVAL_S:-240}
-LEGS="2pc paxos3 abd3o paxos ilock raft5 scr4"
+INTERVAL=${SENTINEL_INTERVAL_S:-120}
+# smoke leads (VERDICT r04 #1a): 8,832 states, warm in seconds — banks a
+# device-labeled datapoint before any long leg can ride a short tunnel
+# window into a wedge.
+LEGS="smoke 2pc paxos3 abd3o paxos ilock raft5 scr4"
 
 cd "$REPO"
 
@@ -40,7 +43,7 @@ bench_main_running() {
     # sentinel firing mid-bench would wedge both claimants. Guard
     # against pid reuse after a crashed bench: the live process must
     # actually BE bench.py.
-    local pidfile=/tmp/stateright_bench_main.pid pid
+    local pidfile="$REPO/.runtime/stateright_bench_main.pid" pid
     [ -f "$pidfile" ] || return 1
     pid=$(cat "$pidfile" 2>/dev/null)
     [ -n "$pid" ] && kill -0 "$pid" 2>/dev/null \

@@ -14,6 +14,7 @@ falls back to a pure-Python store if compilation is impossible.
 from __future__ import annotations
 
 import ctypes
+import hashlib
 import subprocess
 import threading
 from pathlib import Path
@@ -23,10 +24,18 @@ import numpy as np
 
 _DIR = Path(__file__).resolve().parent
 _SRC = _DIR / "fp_store.cc"
-_LIB = _DIR / "_build" / "libfp_store.so"
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 _build_failed = False
+
+
+def _lib_path() -> Path:
+    """Artifact path keyed on a content hash of the source (advisor,
+    round 4): mtime comparisons are meaningless after a git clone (git
+    does not preserve mtimes), and a content key means an edited .cc can
+    never silently load a stale binary."""
+    digest = hashlib.blake2b(_SRC.read_bytes(), digest_size=8).hexdigest()
+    return _DIR / "_build" / f"libfp_store-{digest}.so"
 
 
 def _load() -> Optional[ctypes.CDLL]:
@@ -35,8 +44,9 @@ def _load() -> Optional[ctypes.CDLL]:
         if _lib is not None or _build_failed:
             return _lib
         try:
-            if not _LIB.exists() or _LIB.stat().st_mtime < _SRC.stat().st_mtime:
-                _LIB.parent.mkdir(exist_ok=True)
+            lib_file = _lib_path()
+            if not lib_file.exists():
+                lib_file.parent.mkdir(exist_ok=True)
                 subprocess.run(
                     [
                         "g++",
@@ -46,13 +56,13 @@ def _load() -> Optional[ctypes.CDLL]:
                         "-std=c++17",
                         str(_SRC),
                         "-o",
-                        str(_LIB),
+                        str(lib_file),
                     ],
                     check=True,
                     capture_output=True,
                     timeout=120,
                 )
-            lib = ctypes.CDLL(str(_LIB))
+            lib = ctypes.CDLL(str(lib_file))
         except (OSError, subprocess.SubprocessError):
             _build_failed = True
             return None
