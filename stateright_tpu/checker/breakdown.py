@@ -50,6 +50,24 @@ DEVICE_PEAKS = {
 }
 
 
+def _memory_traffic(compiled) -> float:
+    """Post-fusion HBM traffic estimate of one call: arguments read +
+    outputs written + temp buffers written-then-read. This is what the
+    chip's HBM actually moves — XLA's op-level ``bytes accessed``
+    (``_cost``) counts every pre-fusion elementwise op as a full
+    round-trip, overstating fused compute chains by an order of
+    magnitude, which round 4's roofline math inherited."""
+    try:
+        ma = compiled.memory_analysis()
+        return float(
+            ma.argument_size_in_bytes
+            + ma.output_size_in_bytes
+            + 2 * ma.temp_size_in_bytes
+        )
+    except Exception:
+        return 0.0
+
+
 def _cost(compiled) -> Dict[str, float]:
     """FLOPs + bytes from a compiled executable's cost analysis (best
     effort: some backends return None or a list)."""
@@ -114,7 +132,14 @@ def measure_wave_breakdown(
     A = model.packed_action_count()
     B = F * A
     conditions = model.packed_conditions()
-    fp_fn = lambda s: fingerprint_state(model.packed_fingerprint_view(s))  # noqa: E731
+    fp_fn = model.packed_fingerprint
+    # Attribute the pipeline the checker actually runs: models providing
+    # the fps hooks get the fingerprint-only wave (expand_fps / insert /
+    # materialize), everything else the materializing wave.
+    use_fps = (
+        type(model).packed_expand_fps is not BatchableModel.packed_expand_fps
+        and type(model).packed_take is not BatchableModel.packed_take
+    )
 
     def expand(states, mask):
         cand, cvalid = jax.vmap(model.packed_expand)(states)
@@ -133,8 +158,7 @@ def measure_wave_breakdown(
         )
         return jax.vmap(fp_fn)(flat)
 
-    def sort_dedup(chi, clo, cvalid):
-        flat_valid = cvalid.reshape(B)
+    def sort_dedup(chi, clo, flat_valid):
         shi = jnp.where(flat_valid, chi, _U32_MAX)
         slo = jnp.where(flat_valid, clo, _U32_MAX)
         shi, slo, sidx = jax.lax.sort(
@@ -148,26 +172,86 @@ def measure_wave_breakdown(
     def insert(table, shi, slo, active):
         return hashset_insert(table, shi, slo, active)
 
-    def insert_scatter(table, chi, clo, cvalid):
-        return hashset_insert_unsorted(table, chi, clo, cvalid.reshape(B))
+    def insert_scatter(table, chi, clo, flat_valid):
+        return hashset_insert_unsorted(table, chi, clo, flat_valid)
 
-    def compact(cand, sidx, fresh):
-        flat = jax.tree_util.tree_map(
-            lambda x: x.reshape((B,) + x.shape[2:]), cand
-        )
+    def compact_refs(fresh, sidx):
+        """F-compacted source references of the fresh lanes — the wave's
+        next-frontier selection (beyond-F fresh lanes go to later
+        segments/chunks in the real checker). Shared slot math for both
+        pipelines."""
         pos = jnp.cumsum(fresh.astype(jnp.int32)) - 1
         out_slot = jnp.where(fresh & (pos < F), pos, F)
         src_idx = jnp.zeros((F,), jnp.int32).at[out_slot].set(
             sidx, mode="drop"
         )
         taken = jnp.zeros((F,), bool).at[out_slot].set(fresh, mode="drop")
+        return src_idx, taken
+
+    def compact(cand, sidx, fresh):
+        flat = jax.tree_util.tree_map(
+            lambda x: x.reshape((B,) + x.shape[2:]), cand
+        )
+        src_idx, taken = compact_refs(fresh, sidx)
         new_states = jax.tree_util.tree_map(lambda x: x[src_idx], flat)
         return new_states, taken
+
+    def expand_fps(states, mask):
+        hi, lo, v = jax.vmap(model.packed_expand_fps)(states)
+        v = v & mask[:, None]
+        return hi.reshape(B), lo.reshape(B), v.reshape(B)
+
+    def sort_dedup_flat(chi, clo, flat_valid):
+        shi = jnp.where(flat_valid, chi, _U32_MAX)
+        slo = jnp.where(flat_valid, clo, _U32_MAX)
+        shi, slo, sidx = jax.lax.sort(
+            (shi, slo, jnp.arange(B, dtype=jnp.int32)), num_keys=2
+        )
+        uniq = jnp.concatenate(
+            [jnp.ones((1,), bool), (shi[1:] != shi[:-1]) | (slo[1:] != slo[:-1])]
+        )
+        return shi, slo, sidx, flat_valid[sidx] & uniq
+
+    def insert_scatter_flat(table, chi, clo, flat_valid):
+        return hashset_insert_unsorted(table, chi, clo, flat_valid)
+
+    def fps_compact_refs(fresh, sidx):
+        """F-compacted (parent, action) references of the fresh lanes —
+        the wave's next-frontier selection (beyond-F fresh lanes go to
+        later segments/chunks in the real checker)."""
+        pos = jnp.cumsum(fresh.astype(jnp.int32)) - 1
+        out_slot = jnp.where(fresh & (pos < F), pos, F)
+        src_idx = jnp.zeros((F,), jnp.int32).at[out_slot].set(
+            sidx, mode="drop"
+        )
+        taken = jnp.zeros((F,), bool).at[out_slot].set(fresh, mode="drop")
+        return src_idx, taken
+
+    def materialize(states, src_idx):
+        """One F-lane segment of fresh-child materialization (the real
+        pipeline runs ceil(n_new / F) of these per wave)."""
+        parents = jax.tree_util.tree_map(lambda x: x[src_idx // A], states)
+        return jax.vmap(model.packed_take)(parents, src_idx % A)
 
     def fused(table, states, mask):
         # The props result is returned (not dropped) so XLA cannot
         # dead-code-eliminate the predicate out of the fused timing.
         pv = props(states, mask)
+        if use_fps:
+            chi, clo, cvalid = expand_fps(states, mask)
+            if wave_dedup == "scatter":
+                table, fresh, _found, _pending = insert_scatter_flat(
+                    table, chi, clo, cvalid
+                )
+                sidx = jnp.arange(B, dtype=jnp.int32)
+            else:
+                shi, slo, sidx, active = sort_dedup_flat(chi, clo, cvalid)
+                table, fresh, _found, _pending = insert(
+                    table, shi, slo, active
+                )
+            src_idx, taken = fps_compact_refs(fresh, sidx)
+            new_states = materialize(states, src_idx)
+            return table, new_states, taken, pv.any()
         cand, cvalid = expand(states, mask)
         chi, clo = fingerprint(cand)
         if wave_dedup == "scatter":
@@ -189,6 +273,11 @@ def measure_wave_breakdown(
     j_insert_scatter = jax.jit(insert_scatter)
     j_compact = jax.jit(compact)
     j_fused = jax.jit(fused)
+    j_expand_fps = jax.jit(expand_fps)
+    j_sort_flat = jax.jit(sort_dedup_flat)
+    j_insert_scatter_flat = jax.jit(insert_scatter_flat)
+    j_materialize = jax.jit(materialize)
+    j_refs = jax.jit(fps_compact_refs)
 
     # Seed: initial states padded to the frontier width.
     init = model.packed_init_states()
@@ -220,26 +309,53 @@ def measure_wave_breakdown(
         table, states, mask = nxt[0], nxt[1], nxt[2]
 
     frontier_fill = float(mask.sum()) / F
-    cand, cvalid = j_expand(states, mask)
-    chi, clo = j_fp(cand)
-
-    stages = {
-        "expand": (j_expand, (states, mask)),
-        "properties": (j_props, (states, mask)),
-        "fingerprint": (j_fp, (cand,)),
-    }
-    if wave_dedup == "scatter":
-        _, fresh_sc, _, _ = j_insert_scatter(table, chi, clo, cvalid)
-        stages["insert"] = (j_insert_scatter, (table, chi, clo, cvalid))
-        stages["compact"] = (
-            j_compact,
-            (cand, jnp.arange(B, dtype=jnp.int32), fresh_sc),
-        )
+    materialize_segments = None
+    if use_fps:
+        fhi, flo, fvalid = j_expand_fps(states, mask)
+        stages = {
+            "expand_fps": (j_expand_fps, (states, mask)),
+            "properties": (j_props, (states, mask)),
+        }
+        if wave_dedup == "scatter":
+            _, fresh_f, _, _ = j_insert_scatter_flat(table, fhi, flo, fvalid)
+            sidx_f = jnp.arange(B, dtype=jnp.int32)
+            stages["insert"] = (
+                j_insert_scatter_flat,
+                (table, fhi, flo, fvalid),
+            )
+        else:
+            shi, slo, sidx_f, active_f = j_sort_flat(fhi, flo, fvalid)
+            fresh_f = active_f
+            stages["sort_dedup"] = (j_sort_flat, (fhi, flo, fvalid))
+            stages["insert"] = (j_insert, (table, shi, slo, active_f))
+        src_idx_f, _ = j_refs(fresh_f, sidx_f)
+        n_new_rep = int(fresh_f.sum())
+        # The checker materializes fresh lanes in F-wide segments; the
+        # timed stage is ONE segment, and the per-wave totals scale by the
+        # representative wave's segment count.
+        materialize_segments = max(1, -(-n_new_rep // F))
+        stages["materialize"] = (j_materialize, (states, src_idx_f))
     else:
-        shi, slo, sidx, active = j_sort(chi, clo, cvalid)
-        stages["sort_dedup"] = (j_sort, (chi, clo, cvalid))
-        stages["insert"] = (j_insert, (table, shi, slo, active))
-        stages["compact"] = (j_compact, (cand, sidx, active))
+        cand, cvalid = j_expand(states, mask)
+        chi, clo = j_fp(cand)
+
+        stages = {
+            "expand": (j_expand, (states, mask)),
+            "properties": (j_props, (states, mask)),
+            "fingerprint": (j_fp, (cand,)),
+        }
+        if wave_dedup == "scatter":
+            _, fresh_sc, _, _ = j_insert_scatter(table, chi, clo, cvalid)
+            stages["insert"] = (j_insert_scatter, (table, chi, clo, cvalid))
+            stages["compact"] = (
+                j_compact,
+                (cand, jnp.arange(B, dtype=jnp.int32), fresh_sc),
+            )
+        else:
+            shi, slo, sidx, active = j_sort(chi, clo, cvalid)
+            stages["sort_dedup"] = (j_sort, (chi, clo, cvalid))
+            stages["insert"] = (j_insert, (table, shi, slo, active))
+            stages["compact"] = (j_compact, (cand, sidx, active))
     out = {
         "frontier_capacity": F,
         "action_count": A,
@@ -252,33 +368,57 @@ def measure_wave_breakdown(
     }
     total_bytes = 0.0
     total_flops = 0.0
+    if materialize_segments is not None:
+        # materialize stage numbers are per F-lane segment; totals below
+        # scale them by this count (the representative wave's real cost).
+        out["materialize_segments_per_wave"] = materialize_segments
+        out["pipeline"] = "fps"
     for name, (fn, args) in stages.items():
-        out["stages_ms"][name] = round(_time_stage(fn, args, iters) * 1e3, 4)
+        scale = (
+            materialize_segments
+            if name == "materialize" and materialize_segments
+            else 1
+        )
+        out["stages_ms"][name] = round(
+            _time_stage(fn, args, iters) * 1e3 * scale, 4
+        )
         cost = _cost(fn.lower(*args).compile())
         if cost:
+            cost = {k: v * scale for k, v in cost.items()}
             out["stage_cost"][name] = cost
             total_bytes += cost["bytes"]
             total_flops += cost["flops"]
     out["fused_wave_ms"] = round(
         _time_stage(j_fused, (table, states, mask), iters) * 1e3, 4
     )
+    fused_compiled = j_fused.lower(table, states, mask).compile()
+    fused_traffic = _memory_traffic(fused_compiled)
 
     # Normalize: candidates processed per wave is the honest denominator
     # for "bytes per state" (every candidate is fingerprinted/sorted
     # whether or not it turns out fresh).
     out["candidates_per_wave"] = B
     if total_bytes:
+        # Op-level (pre-fusion) accounting: an upper bound that charges
+        # every elementwise op a full memory round-trip.
         out["bytes_per_candidate"] = round(total_bytes / B, 1)
         out["flops_per_candidate"] = round(total_flops / B, 1)
+    if fused_traffic:
+        # Post-fusion buffer traffic of the ONE fused executable the
+        # checker actually runs per wave — the honest HBM figure for
+        # roofline math (BASELINE.md north-star feasibility).
+        out["hbm_bytes_per_candidate"] = round(fused_traffic / B, 1)
+        out["fused_wave_hbm_bytes"] = fused_traffic
     kind = out["device_kind"]
     peak = DEVICE_PEAKS.get(kind) or next(
         (v for k, v in DEVICE_PEAKS.items() if kind.startswith(k)), None
     )
-    if peak and total_bytes:
+    if peak and (fused_traffic or total_bytes):
         # Roofline: the time HBM alone would need for the wave's traffic,
-        # over the measured fused time. Low attainment = dispatch/latency
-        # bound (small waves) or compute-bound stages.
-        ideal_s = total_bytes / (peak["hbm_gbps"] * 1e9)
+        # over the measured fused time. Post-fusion traffic when the
+        # backend reports it (op-level bytes otherwise). Low attainment =
+        # dispatch/latency bound (small waves) or compute-bound stages.
+        ideal_s = (fused_traffic or total_bytes) / (peak["hbm_gbps"] * 1e9)
         out["hbm_peak_gbps"] = peak["hbm_gbps"]
         out["hbm_roofline_attainment"] = round(
             ideal_s / (out["fused_wave_ms"] / 1e3), 4

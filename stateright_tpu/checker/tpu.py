@@ -406,6 +406,7 @@ class TpuBfsChecker(Checker):
         pool_factor=16,
         hashset_impl="xla",
         wave_dedup=None,
+        expand_fps=None,
     ):
         model = options.model
         if not isinstance(model, BatchableModel):
@@ -536,7 +537,7 @@ class TpuBfsChecker(Checker):
 
         # Fingerprints go through the model's view hook (e.g. actor systems
         # exclude crash flags, mirroring the host state hash).
-        self._fp_fn = lambda s: fingerprint_state(model.packed_fingerprint_view(s))
+        self._fp_fn = model.packed_fingerprint
         # Dedup keys: plain fingerprints, or — under symmetry reduction —
         # the minimum fingerprint over every actor permutation (an
         # orbit-proper canonical key; see core/batch.py for why the
@@ -544,6 +545,39 @@ class TpuBfsChecker(Checker):
         self._symmetry_enabled = options._symmetry is not None
         self._sym_scheme = sym_key_scheme(options._symmetry)
         self._key_fn = _make_key_fn(model, self._fp_fn, options._symmetry)
+        # Fingerprint-only expansion (the byte diet, VERDICT r04 #2): when
+        # the model provides ``packed_expand_fps`` + ``packed_take``, the
+        # wave dedups on candidate fingerprints computed from deltas and
+        # materializes ONLY the fresh lanes — candidate states never
+        # round-trip through HBM. ``expand_fps``: None = auto (on when
+        # supported), True = require, False = force the materializing wave.
+        has_fps = (
+            type(model).packed_expand_fps
+            is not BatchableModel.packed_expand_fps
+            and type(model).packed_take is not BatchableModel.packed_take
+            and model.packed_expand_fps_supported()
+        )
+        if expand_fps is None:
+            # Symmetry needs candidate states for orbit keys; fps path
+            # yields to the materializing wave there.
+            self._use_fps = has_fps and not self._symmetry_enabled
+        elif expand_fps:
+            if not has_fps:
+                raise ValueError(
+                    "expand_fps=True requires the model to implement "
+                    "packed_expand_fps and packed_take (and "
+                    "packed_expand_fps_supported() to allow them — e.g. a "
+                    "codec boundary without a per-row decomposition "
+                    "vetoes the fps wave)"
+                )
+            if self._symmetry_enabled:
+                raise ValueError(
+                    "expand_fps is incompatible with symmetry reduction "
+                    "(orbit keys need candidate states)"
+                )
+            self._use_fps = True
+        else:
+            self._use_fps = False
         self._jit_wave = jax.jit(self._wave)
         self._wave_exec = {}  # table capacity -> AOT-compiled wave
         self._jit_drain = jax.jit(self._deep_drain)
@@ -553,6 +587,7 @@ class TpuBfsChecker(Checker):
         self._jit_init = jax.jit(self._init_wave)
         self._jit_take = jax.jit(self._take, static_argnums=(2,))
         self._jit_finish = jax.jit(self._finish, static_argnums=(2,))
+        self._jit_materialize = jax.jit(self._materialize)
         self._jit_rehash = jax.jit(self._rehash)
         self._jit_fp_single = jax.jit(self._fp_fn)
 
@@ -626,29 +661,45 @@ class TpuBfsChecker(Checker):
                 cond_vals[pi], ebits_after & ~jnp.uint32(1 << b), ebits_after
             )
 
-        # Expand the F × A action grid (packed_expand: per-class fast
-        # path where the model provides one, else vmap of packed_step).
-        cand, cvalid = jax.vmap(model.packed_expand)(states)
-        cvalid = cvalid & eval_mask[:, None]
-        cvalid = cvalid & jax.vmap(jax.vmap(model.packed_within_boundary))(cand)
-        generated = cvalid.sum(dtype=jnp.int32)
-        terminal = eval_mask & ~cvalid.any(axis=1)
-
-        # Fingerprint all candidates, dedup within the wave by sorting.
-        cand_flat = jax.tree_util.tree_map(
-            lambda x: x.reshape((B,) + x.shape[2:]), cand
-        )
-        cvalid_flat = cvalid.reshape(B)
-        chi, clo = jax.vmap(self._fp_fn)(cand_flat)
-        # Dedup/visited-set keys (== the fingerprints unless symmetry is on,
-        # when they are orbit-minimum fingerprints). Frontier rows, parent
-        # pointers, and discoveries always carry the ORIGINAL fingerprints
-        # so paths replay through concrete states (the reference keeps
-        # original fps under symmetry too, src/checker/dfs.rs:300-309).
-        if self._symmetry_enabled:
-            khi, klo = self._key_fn(cand_flat)
-        else:
+        if self._use_fps:
+            # Fingerprint-only expansion: candidate fps computed from the
+            # parent's component hashes + per-transition deltas; no
+            # candidate state arrays exist. Validity (including
+            # within-boundary) is the model's contract (core/batch.py).
+            chi_g, clo_g, cvalid = jax.vmap(model.packed_expand_fps)(states)
+            cvalid = cvalid & eval_mask[:, None]
+            generated = cvalid.sum(dtype=jnp.int32)
+            terminal = eval_mask & ~cvalid.any(axis=1)
+            cvalid_flat = cvalid.reshape(B)
+            chi, clo = chi_g.reshape(B), clo_g.reshape(B)
             khi, klo = chi, clo
+        else:
+            # Expand the F × A action grid (packed_expand: per-class fast
+            # path where the model provides one, else vmap of packed_step).
+            cand, cvalid = jax.vmap(model.packed_expand)(states)
+            cvalid = cvalid & eval_mask[:, None]
+            cvalid = cvalid & jax.vmap(
+                jax.vmap(model.packed_within_boundary)
+            )(cand)
+            generated = cvalid.sum(dtype=jnp.int32)
+            terminal = eval_mask & ~cvalid.any(axis=1)
+
+            # Fingerprint all candidates, dedup within the wave by sorting.
+            cand_flat = jax.tree_util.tree_map(
+                lambda x: x.reshape((B,) + x.shape[2:]), cand
+            )
+            cvalid_flat = cvalid.reshape(B)
+            chi, clo = jax.vmap(self._fp_fn)(cand_flat)
+            # Dedup/visited-set keys (== the fingerprints unless symmetry is
+            # on, when they are orbit-minimum fingerprints). Frontier rows,
+            # parent pointers, and discoveries always carry the ORIGINAL
+            # fingerprints so paths replay through concrete states (the
+            # reference keeps original fps under symmetry too,
+            # src/checker/dfs.rs:300-309).
+            if self._symmetry_enabled:
+                khi, klo = self._key_fn(cand_flat)
+            else:
+                khi, klo = chi, clo
         if self._wave_dedup == "scatter":
             # Sort-free dedup: the duplicate-tolerant insert resolves
             # in-wave twins itself (owner-ticket tie-break), so the
@@ -689,20 +740,28 @@ class TpuBfsChecker(Checker):
         zu = jnp.zeros((B,), jnp.uint32)
         src_idx = zi.at[out_slot].set(sidx, mode="drop")
         parent_row = sidx // A
-        new_states = jax.tree_util.tree_map(lambda x: x[src_idx], cand_flat)
+        new = {
+            "hi": zu.at[out_slot].set(chi[sidx], mode="drop"),
+            "lo": zu.at[out_slot].set(clo[sidx], mode="drop"),
+            "ebits": zu.at[out_slot].set(ebits_after[parent_row], mode="drop"),
+            "depth": zi.at[out_slot].set(depth[parent_row] + 1, mode="drop"),
+        }
+        if self._use_fps:
+            # Fresh lanes as (parent, action) references; the consumer
+            # materializes them F_max at a time (enqueue segments / the
+            # drain's segment loop) so only winners are ever built.
+            new["src_idx"] = src_idx
+        else:
+            new["states"] = jax.tree_util.tree_map(
+                lambda x: x[src_idx], cand_flat
+            )
         out = {
             "table": table,
             "generated": generated,
             "n_new": n_new,
             "overflow": overflow,
             "max_depth": jnp.max(jnp.where(mask, depth, 0)),
-            "new": {
-                "states": new_states,
-                "hi": zu.at[out_slot].set(chi[sidx], mode="drop"),
-                "lo": zu.at[out_slot].set(clo[sidx], mode="drop"),
-                "ebits": zu.at[out_slot].set(ebits_after[parent_row], mode="drop"),
-                "depth": zi.at[out_slot].set(depth[parent_row] + 1, mode="drop"),
-            },
+            "new": new,
             "parent_hi": zu.at[out_slot].set(hi[parent_row], mode="drop"),
             "parent_lo": zu.at[out_slot].set(lo[parent_row], mode="drop"),
         }
@@ -756,6 +815,51 @@ class TpuBfsChecker(Checker):
         return ring_push(
             pool, head, count, chunk, chunk["mask"], self._pool_capacity
         )
+
+    def _pool_push_fps(self, pool, head, count, new, parent_states, n_new):
+        """Ring push for the fps wave: fresh lanes arrive as (parent,
+        action) references (``new["src_idx"]``, prefix-compacted), and
+        their states are materialized straight into the ring in F_max-wide
+        segments inside a dynamic-trip-count loop — real traffic is
+        ``n_new`` children, never the F × A candidate grid, and no B-wide
+        state buffer exists between the wave and the ring."""
+        A, F = self._A, self._F_max
+        B = F * A
+        PC = self._pool_capacity
+        lanes = jnp.arange(B, dtype=jnp.int32)
+        valid = lanes < n_new
+        dest = jnp.where(valid, (head + count + lanes) & (PC - 1), PC)
+        meta = {
+            k: pool[k].at[dest].set(new[k], mode="drop")
+            for k in ("hi", "lo", "ebits", "depth")
+        }
+        take = jax.vmap(self._model.packed_take)
+
+        def cond(sc):
+            return sc[0] * F < n_new
+
+        def body(sc):
+            seg, pstates = sc
+            base = seg * F
+            idxs = jax.lax.dynamic_slice_in_dim(new["src_idx"], base, F)
+            parents = jax.tree_util.tree_map(
+                lambda x: x[idxs // A], parent_states
+            )
+            childs = take(parents, idxs % A)
+            seg_lanes = base + jnp.arange(F, dtype=jnp.int32)
+            m = seg_lanes < n_new
+            d = jnp.where(m, (head + count + seg_lanes) & (PC - 1), PC)
+            pstates = jax.tree_util.tree_map(
+                lambda dst, src: dst.at[d].set(src, mode="drop"),
+                pstates,
+                childs,
+            )
+            return seg + 1, pstates
+
+        _, pstates = jax.lax.while_loop(
+            cond, body, (jnp.int32(0), pool["states"])
+        )
+        return {"states": pstates, **meta}, count + n_new
 
     def _pool_take(self, pool, head, count):
         """Dequeues up to ``F_max`` lanes from the ring head as a frontier."""
@@ -890,19 +994,29 @@ class TpuBfsChecker(Checker):
             # Push the fresh (compacted-prefix) lanes at the ring tail, then
             # dequeue the next frontier from the head — strict FIFO keeps
             # exact BFS order, so parent pointers stay shortest-path.
-            pool, count = self._pool_push(
-                c["pool"],
-                c["head"],
-                c["count"],
-                {
-                    "states": new["states"],
-                    "hi": new["hi"],
-                    "lo": new["lo"],
-                    "ebits": new["ebits"],
-                    "depth": new["depth"],
-                    "mask": valid,
-                },
-            )
+            if self._use_fps:
+                pool, count = self._pool_push_fps(
+                    c["pool"],
+                    c["head"],
+                    c["count"],
+                    new,
+                    c["frontier"]["states"],
+                    n_new,
+                )
+            else:
+                pool, count = self._pool_push(
+                    c["pool"],
+                    c["head"],
+                    c["count"],
+                    {
+                        "states": new["states"],
+                        "hi": new["hi"],
+                        "lo": new["lo"],
+                        "ebits": new["ebits"],
+                        "depth": new["depth"],
+                        "mask": valid,
+                    },
+                )
             frontier, head, count = self._pool_take(pool, c["head"], count)
             return {
                 "pool": pool,
@@ -1082,7 +1196,7 @@ class TpuBfsChecker(Checker):
             self._unique_count += n_new
             if n_new:
                 self._log_wave(wave, n_new)
-                self._enqueue(queue, wave, n_new, B)
+                self._enqueue(queue, wave, n_new, B, chunk)
             if not int(stats[2]):
                 return table
             table = self._grow_table(table, self._capacity * 2)
@@ -1402,11 +1516,31 @@ class TpuBfsChecker(Checker):
                 fp64_pairs(wave["key_hi"][:n_new], wave["key_lo"][:n_new])
             )
 
-    def _enqueue(self, queue, wave, n_new, B):
+    def _enqueue(self, queue, wave, n_new, B, chunk):
         target = -(-B // self._F_max) * self._F_max
         padded = self._jit_finish(dict(wave["new"]), jnp.int32(n_new), target)
         for start in range(0, n_new, self._F_max):
-            queue.append(self._jit_take(padded, jnp.int32(start), self._F_max))
+            piece = self._jit_take(padded, jnp.int32(start), self._F_max)
+            if self._use_fps:
+                # Materialize this chunk's fresh children from (parent,
+                # action) references against the producing frontier —
+                # ceil(n_new / F_max) materializations per wave, never the
+                # full F × A grid.
+                piece = self._jit_materialize(chunk["states"], piece)
+            queue.append(piece)
+
+    def _materialize(self, parent_states, piece):
+        """Builds one queue chunk's states via ``packed_take`` from its
+        fresh-lane (parent, action) references (fps wave path). Padding
+        lanes reference parent 0 / action 0 and are masked."""
+        idxs = piece.pop("src_idx")
+        parents = jax.tree_util.tree_map(
+            lambda x: x[idxs // self._A], parent_states
+        )
+        piece["states"] = jax.vmap(self._model.packed_take)(
+            parents, idxs % self._A
+        )
+        return piece
 
     def _visit_chunk(self, chunk):
         mask = np.asarray(chunk["mask"])

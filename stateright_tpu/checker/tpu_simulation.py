@@ -98,7 +98,7 @@ class TpuSimulationChecker(Checker):
         self._done_event = threading.Event()
         self._error: Optional[BaseException] = None
 
-        self._fp_fn = lambda s: fingerprint_state(model.packed_fingerprint_view(s))
+        self._fp_fn = model.packed_fingerprint
         self._jit_steps = jax.jit(self._run_steps)
         self._jit_fp_single = jax.jit(self._fp_fn)
 

@@ -138,6 +138,55 @@ class BatchableModel:
         """
         return state
 
+    def packed_fingerprint(self, state: PackedState):
+        """(hi, lo) uint32 device fingerprint of one packed state — THE
+        fingerprint definition every checker uses (wave dedup, replay,
+        shard routing, checkpoints). Defaults to the word-serial murmur
+        over ``packed_fingerprint_view``; models with component structure
+        override it with a component-hash scheme whose per-candidate cost
+        is the *delta*, not the state width
+        (``PackedActorModel.packed_fingerprint``). Changing a model's
+        scheme changes its visited-key space: ``FP_SCHEME`` plus the
+        packed-model digest guard checkpoints against mixing."""
+        from ..ops.fingerprint import fingerprint_state
+
+        return fingerprint_state(self.packed_fingerprint_view(state))
+
+    def packed_expand_fps(self, state: PackedState):
+        """OPTIONAL fast path: fingerprints + validity of all
+        ``packed_action_count()`` children of one state — WITHOUT
+        materializing the children. Returns ``(hi, lo, valid)``, each of
+        shape ``(A,)``, where ``(hi, lo)`` must equal
+        ``packed_fingerprint(child_a)`` exactly on every valid lane and
+        ``valid`` must equal ``packed_expand``'s validity AND'd with
+        ``packed_within_boundary`` of the child.
+
+        This is the byte-diet half of the wave pipeline: the checkers'
+        fps wave dedups on these fingerprints and only materializes the
+        lanes that survive (``packed_take``), so candidate states never
+        round-trip through HBM. Models signal support by implementing
+        both this and ``packed_take``; equivalence with the materializing
+        path is pinned by ``tests/test_expand_fps.py``."""
+        raise NotImplementedError
+
+    def packed_take(self, state: PackedState, action_id) -> PackedState:
+        """OPTIONAL companion to ``packed_expand_fps``: materializes the
+        single child ``action_id`` of ``state`` (the post-dedup winners
+        only — called on a fraction of the candidate grid). Must produce
+        exactly ``packed_step``'s outcome state on valid actions; validity
+        itself was already established by ``packed_expand_fps``."""
+        raise NotImplementedError
+
+    def packed_expand_fps_supported(self) -> bool:
+        """Whether the fps hooks above are SAFE for this model instance —
+        implementations can veto the fps wave at runtime even though the
+        class provides the hooks (e.g. ``PackedActorModel`` refuses when a
+        codec customizes ``packed_within_boundary`` without the per-row
+        decomposition the fps path needs). Checkers consult this before
+        auto-selecting the fps wave; forcing ``expand_fps=True`` against a
+        veto is an error."""
+        return True
+
     # -- symmetry (optional) -----------------------------------------------
     #
     # Device symmetry reduction is *orbit-proper*: the dedup key is the

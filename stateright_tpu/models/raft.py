@@ -404,6 +404,10 @@ class RaftPackedCodec(ActorPackedCodec):
     def packed_within_boundary(self, model, state):
         return (state["rows"][:, 1] <= model.cfg.max_term).all()
 
+    def packed_row_within_boundary(self, model, row):
+        # Per-row decomposition of the term cap above (fps path contract).
+        return row[1] <= model.cfg.max_term
+
 
 @dataclass
 class RaftModelCfg:

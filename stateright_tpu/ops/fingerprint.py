@@ -31,6 +31,12 @@ __all__ = [
     "fp_to_int",
     "multiset_digest",
     "avalanche32",
+    "component_seeds",
+    "hash_rows",
+    "combine_pairs",
+    "pairs_acc",
+    "acc_finalize",
+    "multiset_row_pairs",
 ]
 
 _C1 = 0xCC9E2D51
@@ -141,21 +147,28 @@ def fingerprint_words(words: jax.Array) -> Tuple[jax.Array, jax.Array]:
         for k in range(_CHUNKS):
             hi = _mm3_round(hi, chi[k])
             lo = _mm3_round(lo, clo[k])
-    hi = _fmix(hi ^ jnp.uint32(n * 4))
-    lo = _fmix(lo ^ jnp.uint32(n * 4 + 1))
-    # Reserve (0, 0) for the hash-set empty sentinel and (MAX, MAX) for the
-    # checkers' invalid-lane sort sentinel.
-    m = jnp.uint32(0xFFFFFFFF)
-    zero = (hi == 0) & (lo == 0)
-    lo = jnp.where(zero, jnp.uint32(1), lo)
-    maxed = (hi == m) & (lo == m)
-    lo = jnp.where(maxed, m - 1, lo)
-    return hi, lo
+    return _finalize_pair(hi, lo, n)
 
 
 def fingerprint_state(state: Any) -> Tuple[jax.Array, jax.Array]:
     """(hi, lo) fingerprint of one packed state pytree. vmap over batches."""
     return fingerprint_words(state_words(state))
+
+
+def multiset_row_pairs(rows: jax.Array):
+    """Per-row (hi, lo) hashes exactly as ``multiset_digest`` folds them —
+    exposed so incremental digest updates (add/remove one row's
+    contribution) produce bit-identical algebra to the full digest. Same
+    multilinear construction as ``hash_rows`` (one multiply + reduce, not
+    a serial chain), under multiset-specific constant salts and seeds."""
+    E, W = rows.shape
+    khi = jnp.asarray(_lin_consts(W, 0x77A11 + 3 * W))
+    klo = jnp.asarray(_lin_consts(W, 0x19D3F + 11 * W))
+    acc_hi = (rows * khi[None, :]).sum(axis=1, dtype=jnp.uint32)
+    acc_lo = (rows * klo[None, :]).sum(axis=1, dtype=jnp.uint32)
+    hi = _fmix(acc_hi ^ jnp.uint32(_SEED_HI))
+    lo = _fmix(acc_lo ^ jnp.uint32(_SEED_LO))
+    return hi, lo
 
 
 def multiset_digest(rows: jax.Array, active: jax.Array) -> jax.Array:
@@ -168,15 +181,7 @@ def multiset_digest(rows: jax.Array, active: jax.Array) -> jax.Array:
     Models fold the digest into their fingerprint view instead of keeping
     unordered tables canonically sorted — removing per-transition and
     per-permutation sorts from the hot path."""
-    E, W = rows.shape
-    hi = jnp.full((E,), jnp.uint32(_SEED_HI))
-    lo = jnp.full((E,), jnp.uint32(_SEED_LO))
-    for w in range(W):
-        col = rows[:, w]
-        hi = _mm3_round(hi, col)
-        lo = _mm3_round(lo, col ^ jnp.uint32(0xA5A5A5A5))
-    hi = _fmix(hi ^ jnp.uint32(W * 4))
-    lo = _fmix(lo ^ jnp.uint32(W * 4 + 1))
+    hi, lo = multiset_row_pairs(rows)
     hi = jnp.where(active, hi, jnp.uint32(0))
     lo = jnp.where(active, lo, jnp.uint32(0))
     xor_hi = jax.lax.reduce(hi, jnp.uint32(0), jax.lax.bitwise_xor, (0,))
@@ -184,6 +189,100 @@ def multiset_digest(rows: jax.Array, active: jax.Array) -> jax.Array:
     return jnp.stack(
         [hi.sum(dtype=jnp.uint32), xor_hi, lo.sum(dtype=jnp.uint32), xor_lo]
     )
+
+
+def _finalize_pair(hi: jax.Array, lo: jax.Array, n: int):
+    """Shared fmix + sentinel nudges: (0, 0) is the hash-set empty slot,
+    (MAX, MAX) the checkers' invalid-lane sort sentinel."""
+    hi = _fmix(hi ^ jnp.uint32(n * 4))
+    lo = _fmix(lo ^ jnp.uint32(n * 4 + 1))
+    m = jnp.uint32(0xFFFFFFFF)
+    zero = (hi == 0) & (lo == 0)
+    lo = jnp.where(zero, jnp.uint32(1), lo)
+    maxed = (hi == m) & (lo == m)
+    lo = jnp.where(maxed, m - 1, lo)
+    return hi, lo
+
+
+def component_seeds(tags: jax.Array):
+    """Per-component seed pairs from integer component tags.
+
+    The tag folds the component's *position* into its hash, so the
+    component-wise state fingerprint stays positional even though each
+    component is hashed independently: actor row 0 with content X and
+    actor row 1 with content X produce different pairs.
+    """
+    t = jnp.asarray(tags, jnp.uint32)
+    hi = _fmix(jnp.uint32(_SEED_HI) ^ (t * jnp.uint32(0x9E3779B9)))
+    lo = _fmix(jnp.uint32(_SEED_LO) ^ (t * jnp.uint32(0x85EBCA6B)))
+    return hi, lo
+
+
+def _lin_consts(width: int, salt: int) -> "np.ndarray":
+    """Deterministic odd uint32 coefficient vector for the multilinear row
+    hash. Host-side ``RandomState`` (the frozen legacy generator — its bit
+    stream is stability-guaranteed across numpy versions, which the
+    fingerprint scheme requires across runs and checkpoints)."""
+    import numpy as np
+
+    rng = np.random.RandomState((0xC0FFEE ^ salt) & 0x7FFFFFFF)
+    k = rng.randint(0, 1 << 32, size=width, dtype=np.uint32)
+    return k | np.uint32(1)
+
+
+def hash_rows(rows: jax.Array, tags: jax.Array):
+    """(hi, lo) pairs of each row of a 2-D uint32 table, seeded per-row by
+    ``tags`` — the component hash of the fingerprint scheme.
+
+    Multilinear construction: ``fmix(Σ_j w_j · K_j  ⊕ tag_seed)`` with
+    independent odd-constant vectors per lane. One multiply + one reduce
+    over the row axis (a mat-vec XLA maps to the MXU on TPU; a handful of
+    fused ops on CPU) instead of a W-step serial murmur chain — the chain
+    was ~16 elementwise ops *per word*, which dominated both wall time and
+    the op-level cost accounting at B-lane batch widths. Multilinear
+    hashing over GF(2^32) with odd coefficients is a classic universal
+    family (pairwise collision ≤ 2⁻³², squared across the two independent
+    lanes); the fmix breaks linearity before pairs enter the cross-
+    component accumulator. The incremental single-component rehash in
+    ``PackedActorModel.packed_expand_fps`` calls this with one row; the
+    direct fingerprint calls it with the whole table — identical by
+    construction (same constants, same seeds)."""
+    R, W = rows.shape
+    khi = jnp.asarray(_lin_consts(W, 0x48AC1 + 2 * W))
+    klo = jnp.asarray(_lin_consts(W, 0x5B3D5 + 7 * W))
+    thi, tlo = component_seeds(tags)
+    acc_hi = (rows * khi[None, :]).sum(axis=1, dtype=jnp.uint32)
+    acc_lo = (rows * klo[None, :]).sum(axis=1, dtype=jnp.uint32)
+    return _fmix(acc_hi ^ thi), _fmix(acc_lo ^ tlo)
+
+
+def pairs_acc(his: jax.Array, los: jax.Array) -> jax.Array:
+    """(4,) sum/xor accumulator over component-hash pairs. Commutative by
+    construction, so a candidate's accumulator is the parent's plus the
+    *changed* components' (new − old, xor-delta) contributions — O(1) per
+    change, no per-candidate chain. Components must be distinct (each
+    appears once); positionality lives in the tag-seeded pair hashes."""
+    xor_hi = jax.lax.reduce(his, jnp.uint32(0), jax.lax.bitwise_xor, (0,))
+    xor_lo = jax.lax.reduce(los, jnp.uint32(0), jax.lax.bitwise_xor, (0,))
+    return jnp.stack(
+        [his.sum(dtype=jnp.uint32), xor_hi, los.sum(dtype=jnp.uint32), xor_lo]
+    )
+
+
+def acc_finalize(acc: jax.Array, n_components: int):
+    """State fingerprint from the component accumulator: both reductions
+    (wrap-sum and xor) feed each output lane so neither algebra's
+    collisions survive alone; fmix avalanches; sentinels reserved."""
+    c = jnp.uint32(n_components)
+    hi = _fmix(acc[0] ^ _rotl(acc[1], 16) ^ (c * jnp.uint32(0x9E3779B9)))
+    lo = _fmix(acc[2] ^ _rotl(acc[3], 16) ^ (c * jnp.uint32(0x85EBCA6B) + 1))
+    return _finalize_pair(hi, lo, n_components)
+
+
+def combine_pairs(his: jax.Array, los: jax.Array):
+    """One (hi, lo) state fingerprint from C component-hash pairs (the
+    direct form of the accumulator scheme — ``pairs_acc`` + finalize)."""
+    return acc_finalize(pairs_acc(his, los), his.shape[0])
 
 
 def fp_to_int(hi, lo) -> int:
@@ -205,4 +304,4 @@ def fp64_pairs(hi, lo):
 # visited-set keys and parent-store fps from a different scheme cannot be
 # mixed into a resumed run. Bump on ANY change to the functions above, the
 # orbit-key scramble, or a model's fingerprint view encoding.
-FP_SCHEME = "mm3x2/msdigest-v4"
+FP_SCHEME = "linhash/comphash-v6"

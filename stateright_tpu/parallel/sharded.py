@@ -248,7 +248,7 @@ class ShardedTpuBfsChecker(Checker):
         )
         # Fingerprints go through the model's view hook (e.g. actor systems
         # exclude crash flags, mirroring the host state hash).
-        self._fp_fn = lambda s: fingerprint_state(model.packed_fingerprint_view(s))
+        self._fp_fn = model.packed_fingerprint
         # Visited/routing keys: orbit-minimum fingerprints under symmetry
         # reduction (see checker/tpu.py and core/batch.py).
         self._symmetry_enabled = options._symmetry is not None

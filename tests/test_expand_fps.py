@@ -1,0 +1,182 @@
+"""Equivalence pins for the fingerprint-only expansion path.
+
+The fps wave (``TpuBfsChecker`` with ``expand_fps``) dedups on candidate
+fingerprints computed from per-transition deltas (``packed_expand_fps``)
+and materializes only fresh lanes (``packed_take``) — candidate states
+never exist as arrays. Correctness rests on three exact contracts, pinned
+here lane-for-lane across the model families (deliver / drop / timeout /
+crash classes, ordered / unordered / duplicating networks, histories):
+
+1. ``packed_expand_fps`` fingerprints == ``packed_fingerprint`` of the
+   ``packed_expand`` candidate, on every valid lane;
+2. ``packed_expand_fps`` validity == ``packed_expand`` validity AND the
+   candidate's ``packed_within_boundary``;
+3. ``packed_take(state, a)`` == the ``packed_expand`` candidate ``a``.
+
+Plus checker-level oracles: the fps wave and the materializing wave agree
+with the reference's exact counts (``examples/paxos.rs:325``,
+``examples/linearizable-register.rs:286``) and with each other.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from stateright_tpu.actor import Network
+from stateright_tpu.models.linearizable_register import AbdModelCfg
+from stateright_tpu.models.paxos import PaxosModelCfg
+from stateright_tpu.models.raft import RaftModelCfg
+from stateright_tpu.models.single_copy_register import SingleCopyModelCfg
+
+
+def _frontier_states(m, waves=3, cap=400):
+    """A few real BFS levels of packed states via the materializing path."""
+    init = m.packed_init_states()
+    states = [
+        {k: np.asarray(v[i]) for k, v in init.items()}
+        for i in range(len(m.init_states()))
+    ]
+    seen = set()
+    out = []
+    exp = jax.jit(m.packed_expand)
+    wb = jax.jit(m.packed_within_boundary)
+    frontier = states
+    for _ in range(waves):
+        nxt = []
+        for st in frontier:
+            cand, valid = exp({k: jnp.asarray(v) for k, v in st.items()})
+            valid = np.asarray(valid)
+            for a in range(valid.shape[0]):
+                if not valid[a]:
+                    continue
+                child = {k: np.asarray(v[a]) for k, v in cand.items()}
+                if not bool(wb({k: jnp.asarray(v) for k, v in child.items()})):
+                    continue
+                key = tuple((k, v.tobytes()) for k, v in sorted(child.items()))
+                if key in seen:
+                    continue
+                seen.add(key)
+                nxt.append(child)
+        out.extend(frontier)
+        frontier = nxt[:cap]
+        if not frontier:
+            break
+    out.extend(frontier)
+    return out[:cap]
+
+
+FAMILIES = {
+    "abd_ordered": lambda: AbdModelCfg(
+        2, 2, network=Network.new_ordered(), envelope_capacity=8,
+        flow_capacity=2,
+    ).into_model(),
+    "abd_unordered": lambda: AbdModelCfg(2, 2).into_model(),
+    "single_copy": lambda: SingleCopyModelCfg(2, 1).into_model(),
+    "paxos": lambda: PaxosModelCfg(2, 3).into_model(),
+    "raft_lossy_timers": lambda: RaftModelCfg(
+        3, max_term=1, lossy=True
+    ).into_model(),
+    "raft_crashes": lambda: RaftModelCfg(
+        3, max_term=1, lossy=True, max_crashes=1
+    ).into_model(),
+}
+
+
+@pytest.mark.parametrize("family", sorted(FAMILIES))
+def test_fps_lane_equivalence(family):
+    m = FAMILIES[family]()
+    A = m.packed_action_count()
+    aids = jnp.arange(A, dtype=jnp.int32)
+
+    @jax.jit
+    def oracle(stj):
+        """Materializing path's view of one state: candidate fps, combined
+        validity, and per-action packed_take rebuilds."""
+        cand, valid = m.packed_expand(stj)
+        valid = valid & jax.vmap(m.packed_within_boundary)(cand)
+        fhi, flo = jax.vmap(m.packed_fingerprint)(cand)
+        tk = jax.vmap(lambda a: m.packed_take(stj, a))(aids)
+        return cand, valid, fhi, flo, tk
+
+    j_fps = jax.jit(m.packed_expand_fps)
+    checked = 0
+    for st in _frontier_states(m, waves=2, cap=40):
+        stj = {k: jnp.asarray(v) for k, v in st.items()}
+        cand, valid, fhi, flo, tk = oracle(stj)
+        hi, lo, v2 = j_fps(stj)
+        valid = np.asarray(valid)
+        assert np.array_equal(valid, np.asarray(v2)), (family, "validity")
+        assert np.array_equal(
+            np.asarray(fhi)[valid], np.asarray(hi)[valid]
+        ), (family, "fingerprint hi")
+        assert np.array_equal(
+            np.asarray(flo)[valid], np.asarray(lo)[valid]
+        ), (family, "fingerprint lo")
+        for k in cand:
+            assert np.array_equal(
+                np.asarray(tk[k])[valid], np.asarray(cand[k])[valid]
+            ), (family, k, "packed_take")
+        checked += int(valid.sum())
+    assert checked > 0, f"{family}: no valid candidates exercised"
+
+
+@pytest.mark.parametrize(
+    "cfg, expected",
+    [
+        (lambda: AbdModelCfg(2, 2).into_model(), 544),
+        (lambda: SingleCopyModelCfg(2, 1).into_model(), 93),
+        (lambda: PaxosModelCfg(2, 3).into_model(), 16_668),
+    ],
+    ids=["abd544", "scr93", "paxos16668"],
+)
+def test_fps_wave_oracle_counts(cfg, expected):
+    c = (
+        cfg()
+        .checker()
+        .spawn_tpu_bfs(frontier_capacity=256, table_capacity=1 << 16)
+        .join()
+    )
+    assert c.worker_error() is None, c.worker_error()
+    assert c._use_fps, "actor models must auto-select the fps wave"
+    assert c.unique_state_count() == expected
+    c.assert_properties()
+
+
+def test_fps_off_matches(two=None):
+    """expand_fps=False forces the materializing wave; counts agree."""
+    m = AbdModelCfg(2, 2).into_model()
+    c = (
+        m.checker()
+        .spawn_tpu_bfs(
+            frontier_capacity=256, table_capacity=1 << 13, expand_fps=False
+        )
+        .join()
+    )
+    assert c.worker_error() is None, c.worker_error()
+    assert not c._use_fps
+    assert c.unique_state_count() == 544
+
+
+def test_fps_knob_validation():
+    from stateright_tpu.models.two_phase_commit import TwoPhaseSys
+
+    with pytest.raises(ValueError, match="packed_expand_fps"):
+        TwoPhaseSys(3).checker().spawn_tpu_bfs(
+            frontier_capacity=64, table_capacity=1 << 10, expand_fps=True
+        )
+
+
+def test_fps_symmetry_yields_to_materializing_wave():
+    """Symmetry needs candidate states for orbit keys: auto-detect must
+    fall back, and forcing fps under symmetry must refuse."""
+    m = RaftModelCfg(3, max_term=1, lossy=True).into_model()
+    b = m.checker().symmetry()
+    c = b.spawn_tpu_bfs(frontier_capacity=128, table_capacity=1 << 13)
+    assert not c._use_fps
+    c.join()
+    assert c.worker_error() is None, c.worker_error()
+    with pytest.raises(ValueError, match="symmetry"):
+        m.checker().symmetry().spawn_tpu_bfs(
+            frontier_capacity=128, table_capacity=1 << 13, expand_fps=True
+        )
