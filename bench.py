@@ -275,6 +275,17 @@ def _run_leg(leg: str, pin_cpu: bool):
         "device": device.platform,
         "run_mode": "in_bench" if "--in-bench" in sys.argv else "solo",
     }
+    # Telemetry trace sink (--trace-out): every wave/drain span this leg's
+    # checker emits streams to the JSONL file; the path rides the leg
+    # result so the bench JSON says where the trace landed
+    # (scripts/trace_summary.py renders it; chrome_trace_from_jsonl
+    # exports Perfetto-loadable JSON).
+    trace_path = _parse_trace_out()
+    if trace_path is not None:
+        from stateright_tpu.telemetry import get_tracer
+
+        get_tracer().add_sink(trace_path)
+        out["trace_path"] = trace_path
 
     specs = _leg_specs()
     if leg not in specs:
@@ -369,6 +380,12 @@ def _run_leg(leg: str, pin_cpu: bool):
     if spec.get("advisory"):
         # Sub-second steady windows are not rate claims (VERDICT r04 #6).
         out["advisory"] = True
+    # Leg-level observability: the wave/occupancy counters the run left in
+    # the registry (scalar instruments only — histograms ride the trace).
+    snap = checker.metrics().snapshot()
+    out["telemetry"] = {
+        k: v for k, v in snap.items() if not isinstance(v, dict)
+    }
     want = spec.get("expect_discovery")
     if want is not None:
         path = checker.discoveries().get(want)
@@ -471,6 +488,27 @@ def _probe_log_summary():
     }
 
 
+def _parse_trace_out():
+    """``--trace-out PATH`` (both forms): attach the telemetry JSONL sink.
+    In the parent PATH is a base; each leg child gets ``PATH.<leg>.jsonl``
+    so per-leg traces never interleave across subprocesses."""
+    for i, arg in enumerate(sys.argv):
+        if arg == "--trace-out":
+            if i + 1 >= len(sys.argv) or sys.argv[i + 1].startswith("--"):
+                raise SystemExit("--trace-out requires a path")
+            return sys.argv[i + 1]
+        if arg.startswith("--trace-out="):
+            return arg.split("=", 1)[1]
+    return None
+
+
+def _trace_out_args(leg: str):
+    base = _parse_trace_out()
+    if base is None:
+        return ()
+    return ("--trace-out", f"{base}.{leg}.jsonl")
+
+
 def _parse_dedup_flag():
     """The one place ``--dedup`` is parsed (both forms, explicit error on
     a missing value — a trailing ``--dedup`` must not IndexError the
@@ -493,11 +531,14 @@ def _dedup_override_args():
     return ("--dedup", value) if value is not None else ()
 
 
-def _leg_subprocess(leg: str, pin_cpu: bool, extra=()):
-    """Runs one leg in a child; returns its result dict or None."""
+def _leg_subprocess(leg: str, pin_cpu: bool, extra=(), trace_name=None):
+    """Runs one leg in a child; returns its result dict or None.
+    ``trace_name`` overrides the trace filename component (the 2pc retry
+    must not reopen — and truncate — the kept CPU result's trace)."""
     argv = [
         sys.executable, __file__, "--leg", leg, "--in-bench",
-        *_dedup_override_args(), *extra,
+        *_dedup_override_args(), *_trace_out_args(trace_name or leg),
+        *extra,
     ]
     # CPU-pinned fallbacks get extra headroom: they exist so the bench
     # always emits a number, and a slow host must not be killed like a
@@ -612,7 +653,10 @@ def _main_benched():
         and _accelerator_usable(attempts=1)
     ):
         log("[2pc] tunnel recovered post-bench; retrying primary leg on device")
-        res = _leg_subprocess("2pc", pin_cpu=False, extra=["--no-host-baseline"])
+        res = _leg_subprocess(
+            "2pc", pin_cpu=False, extra=["--no-host-baseline"],
+            trace_name="2pc_retry",
+        )
         if res is not None and res.get("device") != "cpu":
             # The retry skipped the host baseline; carry the original over.
             res["host_rate"] = results["2pc"].get("host_rate")

@@ -1,0 +1,125 @@
+#!/usr/bin/env python
+"""Per-wave table from a telemetry trace JSONL (stateright_tpu.telemetry).
+
+    python scripts/trace_summary.py TRACE.jsonl [--chrome-out OUT.json]
+
+Reads the JSONL sink a checker run produced (``--trace-out`` on bench.py,
+or ``get_tracer().add_sink(path)`` on any run), prints one row per
+wave/drain span — wall ms, frontier width, generated, new-unique, dedup
+hit-rate, hash-set occupancy — and totals. ``--chrome-out`` additionally
+writes the Chrome trace-event export (load it in https://ui.perfetto.dev
+or chrome://tracing).
+
+Stdlib-only on the read path (json + argparse): trace files outlive the
+runs that wrote them and must stay inspectable on boxes without jax.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def load_events(path):
+    """Events from a JSONL trace; unparseable lines (a killed run's
+    partial tail write) are skipped, never fatal."""
+    events = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                events.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue
+    return events
+
+
+def wave_rows(events):
+    """The per-wave/per-drain span rows, oldest first. Any complete span
+    whose args carry a ``new_unique`` count qualifies — the shape every
+    backend's wave-level span shares."""
+    rows = []
+    for ev in events:
+        if ev.get("ph") != "X":
+            continue
+        args = ev.get("args") or {}
+        if "new_unique" not in args:
+            continue
+        rows.append(
+            {
+                "name": ev.get("name", "?"),
+                "ms": ev.get("dur", 0.0) / 1000.0,
+                "frontier": args.get("frontier"),
+                "generated": args.get("generated", 0),
+                "new_unique": args.get("new_unique", 0),
+                "dedup_pct": 100.0 * args.get("dedup_hit_rate", 0.0),
+                "occupancy_pct": 100.0 * args.get("occupancy", 0.0),
+                "waves": args.get("waves", 1),
+                "phase": args.get("phase", ""),
+            }
+        )
+    return rows
+
+
+def print_table(rows, out=sys.stdout):
+    header = (
+        f"{'#':>4} {'span':<18} {'ms':>9} {'waves':>5} {'frontier':>8} "
+        f"{'generated':>10} {'new':>9} {'dedup%':>7} {'occ%':>6} phase"
+    )
+    out.write(header + "\n")
+    out.write("-" * len(header) + "\n")
+    for i, r in enumerate(rows, 1):
+        out.write(
+            f"{i:>4} {r['name']:<18} {r['ms']:>9.2f} {r['waves']:>5} "
+            f"{str(r['frontier']):>8} {r['generated']:>10} "
+            f"{r['new_unique']:>9} {r['dedup_pct']:>7.1f} "
+            f"{r['occupancy_pct']:>6.1f} {r['phase']}\n"
+        )
+    total_gen = sum(r["generated"] for r in rows)
+    total_new = sum(r["new_unique"] for r in rows)
+    total_ms = sum(r["ms"] for r in rows)
+    dedup = 100.0 * (total_gen - total_new) / total_gen if total_gen else 0.0
+    out.write(
+        f"\ntotal: {len(rows)} spans, {total_ms:.1f} ms, "
+        f"{total_gen} generated, {total_new} new unique "
+        f"({dedup:.1f}% dedup)\n"
+    )
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="Per-wave table from a telemetry trace JSONL."
+    )
+    parser.add_argument("trace", help="JSONL trace file (telemetry sink)")
+    parser.add_argument(
+        "--chrome-out",
+        help="also write Chrome trace-event JSON (Perfetto-loadable)",
+    )
+    args = parser.parse_args(argv)
+
+    events = load_events(args.trace)
+    if not events:
+        print(f"no events in {args.trace}", file=sys.stderr)
+        return 1
+    rows = wave_rows(events)
+    if rows:
+        print_table(rows)
+    else:
+        print(
+            f"{len(events)} events, none with per-wave args "
+            "(host block/trace spans only)",
+        )
+    if args.chrome_out:
+        with open(args.chrome_out, "w") as f:
+            json.dump(
+                {"traceEvents": events, "displayTimeUnit": "ms"}, f
+            )
+        print(f"chrome trace written to {args.chrome_out}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -18,7 +18,14 @@ from .core.path import Path
 from .core.visitor import CheckerVisitor, FnVisitor, PathRecorder, StateRecorder
 from .checker.base import Checker
 from .checker.builder import CheckerBuilder
-from .report import ReportData, ReportDiscovery, Reporter, WriteReporter
+from .report import (
+    ReportData,
+    ReportDiscovery,
+    Reporter,
+    TelemetryReporter,
+    WriteReporter,
+)
+from .telemetry import get_tracer, metrics_registry
 
 __version__ = "0.1.0"
 
@@ -38,7 +45,10 @@ __all__ = [
     "ReportDiscovery",
     "Reporter",
     "StateRecorder",
+    "TelemetryReporter",
     "WriteReporter",
     "fingerprint",
+    "get_tracer",
+    "metrics_registry",
     "stable_hash",
 ]

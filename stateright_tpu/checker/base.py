@@ -62,6 +62,16 @@ class Checker(Generic[State, Action]):
         """The first exception raised by a worker thread, if any."""
         return None
 
+    def metrics(self):
+        """The telemetry metrics registry this checker records into (the
+        process-local default: every backend emits per-wave/per-block
+        counters, gauges, and histograms there — see
+        ``stateright_tpu.telemetry``). ``metrics().snapshot()`` is the
+        cheap point-in-time view reporters and benches consume."""
+        from ..telemetry import metrics_registry
+
+        return metrics_registry()
+
     # -- complete-liveness plumbing (shared by every spawning checker) ------
 
     def _setup_lasso(self, options) -> None:

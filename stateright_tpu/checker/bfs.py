@@ -17,6 +17,7 @@ from typing import Deque, Dict, List, Optional, Tuple
 from ..core.fingerprint import Fingerprint, fingerprint
 from ..core.model import Expectation
 from ..core.path import Path
+from ..telemetry import BlockInstruments, get_tracer
 from .base import Checker
 from .job_market import JobBroker
 
@@ -56,6 +57,11 @@ class BfsChecker(Checker):
             (s, fingerprint(s), ebits, 1) for s in init_states
         )
         self._discoveries: Dict[str, Fingerprint] = {}
+        # Telemetry instruments resolved once (not per block): each block
+        # of ≤BLOCK_SIZE states costs one span + a few counter bumps, so
+        # the always-on layer stays off the per-state hot loop.
+        self._tracer = get_tracer()
+        self._bi = BlockInstruments("bfs")
         self._job_broker: JobBroker[Job] = JobBroker(thread_count)
         self._job_broker.push(pending)
         self._worker_error: Optional[BaseException] = None
@@ -102,6 +108,8 @@ class BfsChecker(Checker):
         # the hot loop off the lock (the reference uses relaxed atomics here).
         generated_count = 0
         block_max_depth = self._max_depth
+        block_span = self._tracer.span("bfs.block")
+        block_span.__enter__()
         try:
             while max_count > 0 and pending:
                 max_count -= 1
@@ -181,6 +189,13 @@ class BfsChecker(Checker):
                 self._state_count += generated_count
                 if block_max_depth > self._max_depth:
                     self._max_depth = block_max_depth
+            self._bi.record(
+                block_span,
+                evaluated=BLOCK_SIZE - max_count,
+                generated=generated_count,
+                max_depth=block_max_depth,
+                unique_total=len(generated),
+            )
 
     # -- Checker surface ---------------------------------------------------
 

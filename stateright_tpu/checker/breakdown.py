@@ -253,6 +253,7 @@ def measure_wave_breakdown(
             new_states = materialize(states, src_idx)
             return table, new_states, taken, pv.any()
         cand, cvalid = expand(states, mask)
+        cvalid = cvalid.reshape(B)  # (F, A) grid -> flat lanes, like _wave
         chi, clo = fingerprint(cand)
         if wave_dedup == "scatter":
             table, fresh, _found, _pending = insert_scatter(
@@ -337,6 +338,7 @@ def measure_wave_breakdown(
         stages["materialize"] = (j_materialize, (states, src_idx_f))
     else:
         cand, cvalid = j_expand(states, mask)
+        cvalid = cvalid.reshape(B)  # flat lanes, matching the fused wave
         chi, clo = j_fp(cand)
 
         stages = {

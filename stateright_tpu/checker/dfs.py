@@ -18,6 +18,7 @@ from typing import Deque, Dict, List, Optional, Set, Tuple
 from ..core.fingerprint import Fingerprint, fingerprint
 from ..core.model import Expectation
 from ..core.path import Path
+from ..telemetry import BlockInstruments, get_tracer
 from .base import Checker
 from .job_market import JobBroker
 
@@ -59,6 +60,9 @@ class DfsChecker(Checker):
             (s, [fingerprint(s)], ebits, 1) for s in init_states
         )
         self._discoveries: Dict[str, List[Fingerprint]] = {}
+        # Per-block telemetry (see the matching note in bfs.py).
+        self._tracer = get_tracer()
+        self._bi = BlockInstruments("dfs")
         self._job_broker: JobBroker[Job] = JobBroker(thread_count)
         self._job_broker.push(pending)
         self._worker_error: Optional[BaseException] = None
@@ -107,6 +111,8 @@ class DfsChecker(Checker):
         # the hot loop off the lock (the reference uses relaxed atomics here).
         generated_count = 0
         block_max_depth = self._max_depth
+        block_span = self._tracer.span("dfs.block")
+        block_span.__enter__()
         try:
             while max_count > 0 and pending:
                 max_count -= 1
@@ -189,6 +195,13 @@ class DfsChecker(Checker):
                 self._state_count += generated_count
                 if block_max_depth > self._max_depth:
                     self._max_depth = block_max_depth
+            self._bi.record(
+                block_span,
+                evaluated=BLOCK_SIZE - max_count,
+                generated=generated_count,
+                max_depth=block_max_depth,
+                unique_total=len(generated),
+            )
 
     # -- Checker surface ---------------------------------------------------
 

@@ -19,6 +19,7 @@ from typing import Deque, Dict, List, Optional, Tuple
 from ..core.fingerprint import Fingerprint, fingerprint
 from ..core.model import Expectation
 from ..core.path import Path
+from ..telemetry import BlockInstruments, get_tracer
 from .base import Checker
 from .bfs import reconstruct_path
 from .job_market import JobBroker
@@ -57,6 +58,9 @@ class OnDemandChecker(Checker):
             (s, fingerprint(s), ebits, 1) for s in init_states
         )
         self._discoveries: Dict[str, Fingerprint] = {}
+        # Per-block telemetry (see the matching note in bfs.py).
+        self._tracer = get_tracer()
+        self._bi = BlockInstruments("on_demand")
         self._job_broker: JobBroker[Job] = JobBroker(thread_count)
         self._job_broker.push(pending)
         self._worker_error: Optional[BaseException] = None
@@ -164,7 +168,10 @@ class OnDemandChecker(Checker):
         for _ in range(min(BLOCK_SIZE, len(targetted))):
             local.append(targetted.popleft())
         generated_count = 0
+        block_size = len(local)
         block_max_depth = self._max_depth
+        block_span = self._tracer.span("on_demand.block")
+        block_span.__enter__()
         try:
             while local:
                 state, state_fp, ebits, depth = local.pop()
@@ -229,6 +236,13 @@ class OnDemandChecker(Checker):
                 self._state_count += generated_count
                 if block_max_depth > self._max_depth:
                     self._max_depth = block_max_depth
+            self._bi.record(
+                block_span,
+                evaluated=block_size - len(local),
+                generated=generated_count,
+                max_depth=block_max_depth,
+                unique_total=len(generated),
+            )
 
     # -- Checker surface ---------------------------------------------------
 

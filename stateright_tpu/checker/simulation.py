@@ -20,6 +20,7 @@ from typing import Dict, List, Optional
 from ..core.fingerprint import Fingerprint, fingerprint
 from ..core.model import Expectation
 from ..core.path import Path
+from ..telemetry import get_tracer, metrics_registry
 from .base import Checker
 
 
@@ -64,6 +65,14 @@ class SimulationChecker(Checker):
         self._count_lock = threading.Lock()
         self._max_depth = 0
         self._discoveries: Dict[str, List[Fingerprint]] = {}
+        # One span per rolled trace (not per step): simulation traces are
+        # the unit the reference reasons about, and tiny traces stay off
+        # the per-state hot loop.
+        self._tracer = get_tracer()
+        reg = metrics_registry()
+        self._m_traces = reg.counter("simulation.traces")
+        self._m_steps = reg.counter("simulation.states_visited")
+        self._m_trace_len = reg.histogram("simulation.trace_len")
         self._worker_error: Optional[BaseException] = None
         self._handles: List[threading.Thread] = []
         self._stop = threading.Event()
@@ -73,14 +82,21 @@ class SimulationChecker(Checker):
                 rng = random.Random(thread_seed)
                 trace_seed = thread_seed
                 while not self._stop.is_set():
-                    self._check_trace_from_initial(
-                        trace_seed,
-                        chooser,
-                        properties,
-                        visitor,
-                        target_max_depth,
-                        symmetry,
-                    )
+                    with self._tracer.span(
+                        "simulation.trace", seed=trace_seed
+                    ) as sp:
+                        trace_len = self._check_trace_from_initial(
+                            trace_seed,
+                            chooser,
+                            properties,
+                            visitor,
+                            target_max_depth,
+                            symmetry,
+                        )
+                        sp.set(trace_len=trace_len)
+                    self._m_traces.inc()
+                    self._m_steps.inc(trace_len)
+                    self._m_trace_len.observe(trace_len)
                     if len(self._discoveries) == property_count:
                         return
                     if (
@@ -130,7 +146,7 @@ class SimulationChecker(Checker):
             ):
                 # Return (not break): we don't know whether this is terminal,
                 # so unmet eventually bits must not become discoveries.
-                return
+                return len(fingerprint_path)
             if not model.within_boundary(state):
                 break
 
@@ -194,6 +210,7 @@ class SimulationChecker(Checker):
             # path to report and is skipped.
             if i in ebits and fingerprint_path and prop.name not in discoveries:
                 discoveries[prop.name] = list(fingerprint_path)
+        return len(fingerprint_path)
 
     # -- Checker surface ---------------------------------------------------
 

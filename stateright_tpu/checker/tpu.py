@@ -62,6 +62,7 @@ from ..ops.hashset import (
     hashset_new,
 )
 from ..ops.ring import ring_export, ring_push, ring_rows, ring_take
+from ..telemetry import WaveInstruments, device_step_annotation, get_tracer
 from .base import Checker
 
 _DEPTH_INF = (1 << 31) - 1
@@ -530,6 +531,12 @@ class TpuBfsChecker(Checker):
         # original fps in the parent store).
         self._key_log: List = []
         self._store = make_fingerprint_store()
+        # Telemetry: instruments resolved once; the wave/drain loops emit
+        # one span per wave (frontier width, new-unique, dedup hit-rate,
+        # hash-set occupancy, max depth) through them — the live
+        # observability the offline breakdown.py stage mirror cannot give.
+        self._tracer = get_tracer()
+        self._wi = WaveInstruments("tpu_bfs")
         self._ingested = 0
         self._ingest_lock = threading.Lock()
         self._done_event = threading.Event()
@@ -1107,11 +1114,24 @@ class TpuBfsChecker(Checker):
         capacity = self._capacity
         while capacity < min_capacity:
             capacity *= 2
-        new_table, leftover = self._jit_rehash(table, hashset_new(capacity))
+        with self._tracer.span(
+            "tpu_bfs.table_grow", from_capacity=self._capacity,
+            to_capacity=capacity,
+        ):
+            new_table, leftover = self._jit_rehash(table, hashset_new(capacity))
         if int(leftover):
             raise RuntimeError("device hash set rehash overflowed probe cap")
         self._capacity = capacity
+        self._wi.table_grows.inc()
+        self._wi.capacity.set(capacity)
         return new_table
+
+    def _set_warmup(self, seconds: float) -> None:
+        """First-result warmup stamp, mirrored into telemetry so traces
+        carry the warmup/steady split the benches subtract."""
+        self.warmup_seconds = seconds
+        self._wi.warmup.set(seconds)
+        self._tracer.instant("tpu_bfs.warmup_complete", warmup_s=seconds)
 
     def _explore(self):
         t_start = time.perf_counter()
@@ -1157,19 +1177,27 @@ class TpuBfsChecker(Checker):
         exe = self._wave_exec.get(key)
         if exe is None:
             t0 = time.perf_counter()
-            exe = self._jit_wave.lower(*args).compile()
+            with self._tracer.span(
+                "tpu_bfs.compile", table_capacity=key[0], frontier=key[1]
+            ):
+                exe = self._jit_wave.lower(*args).compile()
             self._wave_exec[key] = exe
             if self.warmup_seconds is not None:
                 self.warmup_seconds += time.perf_counter() - t0
+                self._wi.warmup.set(self.warmup_seconds)
         return exe(*args)
 
-    def _consume_wave(self, table, wave, chunk, queue, depth_cap):
+    def _consume_wave(self, table, wave, chunk, queue, depth_cap, span=None):
         """Applies one wave output host-side (counters, discoveries, log,
         requeue), retrying the producing frontier after table growth until
-        no probe overflows. Returns the updated table."""
+        no probe overflows. Returns the updated table. ``span`` (optional,
+        a telemetry span covering this wave) is filled with the per-wave
+        quantities the acceptance trace carries."""
         props = self._properties
         B = chunk["hi"].shape[0] * self._A
         attempt = 0
+        generated = 0
+        wave_new = 0
         while True:
             if wave is None:
                 wave = self._call_wave(table, chunk, depth_cap)
@@ -1179,7 +1207,8 @@ class TpuBfsChecker(Checker):
             # pulled only on a hit.
             stats = np.asarray(wave["stats"])
             if attempt == 0:
-                self._state_count += int(stats[0])
+                generated = int(stats[0])
+                self._state_count += generated
                 self._max_depth = max(self._max_depth, int(stats[3]))
                 if props and stats[4]:
                     hit = np.asarray(wave["prop_hit"])
@@ -1193,15 +1222,32 @@ class TpuBfsChecker(Checker):
                 if self._visitor is not None:
                     self._visit_chunk(chunk)
             n_new = int(stats[1])
+            wave_new += n_new
             self._unique_count += n_new
             if n_new:
                 self._log_wave(wave, n_new)
                 self._enqueue(queue, wave, n_new, B, chunk)
             if not int(stats[2]):
+                self._record_wave_metrics(
+                    span, chunk["hi"].shape[0], generated, wave_new
+                )
                 return table
             table = self._grow_table(table, self._capacity * 2)
             attempt += 1
             wave = None
+
+    def _record_wave_metrics(self, span, frontier, generated, n_new):
+        """One wave's telemetry (the shared bundle does the recording)."""
+        self._wi.record(
+            span,
+            frontier=frontier,
+            generated=generated,
+            n_new=n_new,
+            occupancy=self._unique_count / self._capacity,
+            capacity=self._capacity,
+            max_depth=self._max_depth,
+            phase="warmup" if self.warmup_seconds is None else "steady",
+        )
 
     def _explore_waves(self, table, queue, depth_cap, t_start):
         """Wave-at-a-time host loop (visitor callbacks / target counts)."""
@@ -1234,12 +1280,14 @@ class TpuBfsChecker(Checker):
                 table = self._grow_table(
                     table, _pow2ceil(int((self._unique_count + B) / _MAX_LOAD))
                 )
-            with jax.profiler.StepTraceAnnotation(
-                "tpu_bfs.wave", step_num=chunks
-            ):
-                table = self._consume_wave(table, None, chunk, queue, depth_cap)
+            with self._tracer.span(
+                "tpu_bfs.wave", wave=chunks
+            ) as sp, device_step_annotation("tpu_bfs.wave", chunks):
+                table = self._consume_wave(
+                    table, None, chunk, queue, depth_cap, span=sp
+                )
             if self.warmup_seconds is None:
-                self.warmup_seconds = time.perf_counter() - t_start
+                self._set_warmup(time.perf_counter() - t_start)
 
     def _explore_deep(self, table, queue, depth_cap, t_start):
         """Deep-drain host loop: keeps the pending frontier in the device
@@ -1318,21 +1366,21 @@ class TpuBfsChecker(Checker):
                 # exploration, so "time until the first result returned"
                 # (the wave path's proxy) would fold exploration into
                 # warmup and corrupt steady-state rates.
-                self._jit_drain.lower(
-                    table,
-                    pool,
-                    head,
-                    count,
-                    jnp.asarray(undiscovered),
-                    budget,
-                    depth_cap,
-                ).compile()
+                with self._tracer.span("tpu_bfs.compile", kind="drain"):
+                    self._jit_drain.lower(
+                        table,
+                        pool,
+                        head,
+                        count,
+                        jnp.asarray(undiscovered),
+                        budget,
+                        depth_cap,
+                    ).compile()
                 compiled = True
                 if self.warmup_seconds is None:
-                    self.warmup_seconds = time.perf_counter() - t_start
-            with jax.profiler.StepTraceAnnotation(
-                "tpu_bfs.drain", step_num=drains
-            ):
+                    self._set_warmup(time.perf_counter() - t_start)
+            drain_span = self._tracer.span("tpu_bfs.drain", drain=drains)
+            with drain_span, device_step_annotation("tpu_bfs.drain", drains):
                 res = self._jit_drain(
                     table,
                     pool,
@@ -1343,10 +1391,31 @@ class TpuBfsChecker(Checker):
                     depth_cap,
                 )
                 dstats = np.asarray(res["drain_stats"])
-            log_n = int(dstats[0])
-            self._state_count += int(dstats[1])
-            self._unique_count += int(dstats[2])
-            self._max_depth = max(self._max_depth, int(dstats[3]))
+                log_n = int(dstats[0])
+                self._state_count += int(dstats[1])
+                self._unique_count += int(dstats[2])
+                self._max_depth = max(self._max_depth, int(dstats[3]))
+                # A drain consumes many waves device-side; its span carries
+                # the aggregate (per-wave granularity would need per-wave
+                # host exits — the cost the drain exists to amortize). The
+                # drain's final, unconsumed wave is accounted by the
+                # _consume_wave call below, hence waves - 1 here.
+                self._wi.drains.inc()
+                self._wi.waves.inc(max(int(dstats[4]) - 1, 0))
+                self._wi.record(
+                    drain_span,
+                    frontier=self._F_max,
+                    generated=int(dstats[1]),
+                    n_new=int(dstats[2]),
+                    occupancy=self._unique_count / self._capacity,
+                    capacity=self._capacity,
+                    max_depth=self._max_depth,
+                    count_wave=False,
+                    observe=False,
+                    waves=int(dstats[4]),
+                    log_n=log_n,
+                    ring_count=int(dstats[5]),
+                )
             pool, head, count = res["pool"], res["head"], res["count"]
             pool_count = int(dstats[5])
             if log_n:
@@ -1360,9 +1429,11 @@ class TpuBfsChecker(Checker):
             # Consume the final (unconsumable device-side) wave the slow
             # way; its fresh chunks spill into the host queue and are fed
             # back into the ring on the next loop pass.
-            table = self._consume_wave(
-                table, res["out"], res["frontier"], queue, depth_cap
-            )
+            with self._tracer.span("tpu_bfs.wave", drain=drains) as sp:
+                table = self._consume_wave(
+                    table, res["out"], res["frontier"], queue, depth_cap,
+                    span=sp,
+                )
 
     def _export_pool_chunks(self, pool, head, count):
         """The ring contents as F_max-wide host chunks (for checkpoints)."""
@@ -1387,6 +1458,10 @@ class TpuBfsChecker(Checker):
         table = out["table"]
         self._state_count = int(out["n_valid"])
         self._unique_count = int(out["n_unique"])
+        # Seed the cumulative counters too, so the registry's totals match
+        # the checker's (init states never flow through a wave).
+        self._wi.generated.inc(self._state_count)
+        self._wi.unique.inc(self._unique_count)
         hi = np.asarray(out["hi"])
         lo = np.asarray(out["lo"])
         valid = np.asarray(out["valid"])

@@ -34,6 +34,7 @@ from ..core.batch import BatchableModel
 from ..core.model import Expectation
 from ..core.path import Path
 from ..ops.fingerprint import fingerprint_state, fp_to_int
+from ..telemetry import device_step_annotation, get_tracer, metrics_registry
 from .base import Checker
 
 _NEG_INF = -1e30
@@ -303,14 +304,28 @@ class TpuSimulationChecker(Checker):
         if not props:
             return
         carry = self._fresh_carry()
+        tracer = get_tracer()
+        reg = metrics_registry()
+        m_calls = reg.counter("tpu_sim.step_calls")
+        m_states = reg.counter("tpu_sim.states_visited")
         # The device counter is int32 (jnp.int64 needs x64 mode) and would
         # wrap after ~2.15B counted lane-steps if carried across calls, so
         # each _jit_steps call counts from zero and the host accumulates.
         count = 0
+        calls = 0
         while True:
-            carry = self._jit_steps(carry)
-            lanes, stats, disc = carry
-            count += int(stats["count"])
+            calls += 1
+            with tracer.span(
+                "tpu_sim.steps", call=calls, lanes=self._L,
+                steps_per_call=self._K,
+            ) as sp, device_step_annotation("tpu_sim.steps", calls):
+                carry = self._jit_steps(carry)
+                lanes, stats, disc = carry
+                step_count = int(stats["count"])
+                sp.set(states=step_count)
+            m_calls.inc()
+            m_states.inc(step_count)
+            count += step_count
             self._state_count = count
             self._max_depth = max(self._max_depth, int(stats["max_depth"]))
             carry = (

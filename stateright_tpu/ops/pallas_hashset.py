@@ -149,6 +149,22 @@ def _insert_kernel(
         dma_out.wait()
 
 
+def _compiler_params(pltpu):
+    """The ``has_side_effects`` compiler params across jax versions, by
+    capability not name: jax >= 0.7 calls the class ``CompilerParams``,
+    0.5/0.6 spell it ``TPUCompilerParams`` with the same field, and 0.4.x
+    has neither field — there the legacy mosaic dict form carries it."""
+    cls = getattr(pltpu, "CompilerParams", None) or getattr(
+        pltpu, "TPUCompilerParams", None
+    )
+    if cls is not None:
+        try:
+            return cls(has_side_effects=True)
+        except TypeError:
+            pass
+    return dict(mosaic=dict(has_side_effects=True))
+
+
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def pallas_hashset_insert(
     table: jax.Array,
@@ -216,7 +232,7 @@ def pallas_hashset_insert(
             jax.ShapeDtypeStruct((B,), jnp.uint32),
         ),
         input_output_aliases={5: 0},  # table (arg idx incl. 2 prefetch args)
-        compiler_params=pltpu.CompilerParams(has_side_effects=True),
+        compiler_params=_compiler_params(pltpu),
         interpret=interpret,
     )(
         starts,

@@ -44,7 +44,18 @@ from typing import Dict, List, Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
+
+try:  # jax >= 0.6: top-level export, replication check named check_vma
+    from jax import shard_map
+except ImportError:  # jax 0.4.x: experimental module, check_rep instead
+    from jax.experimental.shard_map import shard_map as _shard_map_compat
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+        return _shard_map_compat(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_rep=check_vma,
+        )
+
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..core.batch import BatchableModel
@@ -54,6 +65,7 @@ from ..native import make_fingerprint_store
 from ..ops.fingerprint import fingerprint_state, fp64_pairs, fp_to_int
 from ..ops.hashset import MAX_PROBES, hashset_insert
 from ..ops.ring import ring_export, ring_push, ring_rows, ring_take
+from ..telemetry import WaveInstruments, device_step_annotation, get_tracer
 from .base_mesh import default_mesh
 from ..checker.base import Checker
 from ..checker.tpu import (
@@ -261,6 +273,11 @@ class ShardedTpuBfsChecker(Checker):
             else self._jit_fp_batch
         )
         self._jit_fp_single = jax.jit(self._fp_fn)
+
+        # Telemetry: one span per host-visible wave/drain (see
+        # stateright_tpu.telemetry); occupancy is global across shards.
+        self._tracer = get_tracer()
+        self._wi = WaveInstruments("sharded_bfs")
 
         self._handles = [
             threading.Thread(target=self._run, name="sharded-tpu-bfs", daemon=True)
@@ -941,36 +958,47 @@ class ShardedTpuBfsChecker(Checker):
             dev = self._put_chunk(chunk)
 
             attempt = 0
-            while True:
-                wave = self._call_wave(table, dev, depth_cap)
-                table = wave["table"]
-                if attempt == 0:
-                    self._state_count += int(self._pull(wave["generated"]).sum())
-                    self._max_depth = max(
-                        self._max_depth, int(self._pull(wave["max_depth"]).max())
-                    )
-                    if props:
-                        hit = self._pull(wave["prop_hit"])
-                        phi = self._pull(wave["prop_hi"])
-                        plo = self._pull(wave["prop_lo"])
-                        for i, p in enumerate(props):
-                            if p.name in self._discoveries_fp:
-                                continue
-                            for d in range(n):
-                                if hit[d, i]:
-                                    self._discoveries_fp[p.name] = fp_to_int(
-                                        phi[d, i], plo[d, i]
-                                    )
-                                    break
-                    if self._visitor is not None:
-                        self._visit_chunk(chunk)
-                self._harvest(wave)
-                if not int(self._pull(wave["overflow"]).sum()):
-                    break
-                table = self._grow_table(table, self._cap_loc * 2)
-                attempt += 1
+            wave_generated = 0
+            wave_new = 0
+            with self._tracer.span(
+                "sharded_bfs.wave", wave=chunks
+            ) as sp, device_step_annotation("sharded_bfs.wave", chunks):
+                while True:
+                    wave = self._call_wave(table, dev, depth_cap)
+                    table = wave["table"]
+                    if attempt == 0:
+                        wave_generated = int(
+                            self._pull(wave["generated"]).sum()
+                        )
+                        self._state_count += wave_generated
+                        self._max_depth = max(
+                            self._max_depth,
+                            int(self._pull(wave["max_depth"]).max()),
+                        )
+                        if props:
+                            hit = self._pull(wave["prop_hit"])
+                            phi = self._pull(wave["prop_hi"])
+                            plo = self._pull(wave["prop_lo"])
+                            for i, p in enumerate(props):
+                                if p.name in self._discoveries_fp:
+                                    continue
+                                for d in range(n):
+                                    if hit[d, i]:
+                                        self._discoveries_fp[p.name] = (
+                                            fp_to_int(phi[d, i], plo[d, i])
+                                        )
+                                        break
+                        if self._visitor is not None:
+                            self._visit_chunk(chunk)
+                    wave_new += self._harvest(wave)
+                    if not int(self._pull(wave["overflow"]).sum()):
+                        break
+                    table = self._grow_table(table, self._cap_loc * 2)
+                    attempt += 1
+                self._record_wave_metrics(sp, G, wave_generated, wave_new)
             if self.warmup_seconds is None:
                 self.warmup_seconds = time.perf_counter() - self._t_start
+                self._wi.warmup.set(self.warmup_seconds)
             # Re-ingest fresh rows for the next chunks.
             del dev
 
@@ -1110,14 +1138,37 @@ class ShardedTpuBfsChecker(Checker):
                     self.warmup_seconds = (
                         time.perf_counter() - self._t_start
                     )
-            with jax.profiler.StepTraceAnnotation(
-                "sharded_bfs.drain", step_num=drains
+                    self._wi.warmup.set(self.warmup_seconds)
+            drain_span = self._tracer.span("sharded_bfs.drain", drain=drains)
+            with drain_span, device_step_annotation(
+                "sharded_bfs.drain", drains
             ):
                 res = self._jit_deep_drain(*args)
                 dstats = self._pull(res["drain_stats"])  # (n, 10)
-            self._state_count += int(dstats[:, 1].sum())
-            self._unique_count += int(dstats[:, 2].sum())
-            self._max_depth = max(self._max_depth, int(dstats[:, 3].max()))
+                drain_generated = int(dstats[:, 1].sum())
+                drain_new = int(dstats[:, 2].sum())
+                self._state_count += drain_generated
+                self._unique_count += drain_new
+                self._max_depth = max(
+                    self._max_depth, int(dstats[:, 3].max())
+                )
+                # Aggregate span per drain (per-wave host exits are the
+                # cost the drain amortizes away); the final unconsumed
+                # wave is accounted by _consume_final below.
+                self._wi.drains.inc()
+                self._wi.waves.inc(int(dstats[:, 4].max()))
+                self._wi.record(
+                    drain_span,
+                    frontier=self._G,
+                    generated=drain_generated,
+                    n_new=drain_new,
+                    occupancy=self._unique_count / (self._n * self._cap_loc),
+                    capacity=self._n * self._cap_loc,
+                    max_depth=self._max_depth,
+                    count_wave=False,
+                    observe=False,
+                    waves=int(dstats[:, 4].max()),
+                )
             pool, head, count = res["pool"], res["head"], res["count"]
             ring_est = int(dstats[:, 5].max())
             # The whole drain's parent-fp stream: one (n, 6, Ll) transfer,
@@ -1171,6 +1222,9 @@ class ShardedTpuBfsChecker(Checker):
         n_new = dstats[:, 6]
         total_new = int(n_new.sum())
         self._unique_count += total_new
+        self._wi.unique.inc(total_new)
+        self._wi.generated.inc(int(dstats[:, 7].sum()))
+        self._wi.wave_new.observe(total_new)
         if total_new:
             B = self._F_loc * self._A
             hi = self._pull(final["new_hi"]).reshape(n, B)
@@ -1214,7 +1268,7 @@ class ShardedTpuBfsChecker(Checker):
                 # the wave path.
                 wave = self._call_wave(table, fr, depth_cap)
                 table = wave["table"]
-                self._harvest(wave)
+                self._wi.unique.inc(self._harvest(wave))
                 if not int(self._pull(wave["overflow"]).sum()):
                     break
         return table, pool, head, count, ring_est
@@ -1280,6 +1334,9 @@ class ShardedTpuBfsChecker(Checker):
         fresh = self._pull(out["fresh"])
         self._state_count = int(valid.sum())
         self._unique_count = int(fresh.sum())
+        # Seed the cumulative counters too (init states skip the waves).
+        self._wi.generated.inc(self._state_count)
+        self._wi.unique.inc(self._unique_count)
         child64 = fp64_pairs(hi, lo)
         self._wave_log.append((child64[fresh], np.zeros((fresh.sum(),), np.uint64)))
         if self._symmetry_enabled:
@@ -1413,12 +1470,13 @@ class ShardedTpuBfsChecker(Checker):
         return table
 
     def _harvest(self, wave):
-        """Pulls each device's compacted fresh rows into the host pool."""
+        """Pulls each device's compacted fresh rows into the host pool;
+        returns the global fresh count (telemetry)."""
         n_new = self._pull(wave["n_new"])
         total = int(n_new.sum())
         self._unique_count += total
         if not total:
-            return
+            return total
         B = self._G * self._A // self._n
         hi = self._pull(wave["new_hi"])
         lo = self._pull(wave["new_lo"])
@@ -1447,6 +1505,21 @@ class ShardedTpuBfsChecker(Checker):
                 "ebits": ebits[sel].astype(np.uint32),
                 "depth": depth[sel].astype(np.int32),
             }
+        )
+        return total
+
+    def _record_wave_metrics(self, span, frontier, generated, n_new):
+        """One host-visible wave's telemetry (the shared bundle does the
+        recording; occupancy is global across the mesh's shards)."""
+        self._wi.record(
+            span,
+            frontier=frontier,
+            generated=generated,
+            n_new=n_new,
+            occupancy=self._unique_count / (self._n * self._cap_loc),
+            capacity=self._n * self._cap_loc,
+            max_depth=self._max_depth,
+            phase="warmup" if self.warmup_seconds is None else "steady",
         )
 
     def _visit_chunk(self, chunk):

@@ -8,8 +8,9 @@ the compatibility surface — golden-tested and grepped by bench harnesses.
 
 from __future__ import annotations
 
+import json
 from dataclasses import dataclass
-from typing import IO, Dict
+from typing import IO, Dict, Optional
 
 
 @dataclass
@@ -62,3 +63,45 @@ class WriteReporter(Reporter):
                 f'Discovered "{name}" {discovery.classification} {discovery.path}'
             )
             self.writer.write(f"Fingerprint path: {discovery.path.encode()}\n")
+
+
+class TelemetryReporter(Reporter):
+    """Renders telemetry metrics snapshots alongside (not instead of) an
+    inner reporter's output. The golden ``WriteReporter`` strings are a
+    compatibility surface, so this reporter never alters them: it
+    delegates every callback to the wrapped reporter verbatim, then — on
+    the final (done) report — writes one ``Telemetry <json>`` line from
+    the metrics registry. Wrap-free use (``inner=None``) emits only the
+    telemetry line.
+
+        checker.join_and_report(
+            TelemetryReporter(sys.stdout, inner=WriteReporter(sys.stdout))
+        )
+    """
+
+    def __init__(self, writer: IO[str], inner: Optional[Reporter] = None,
+                 registry=None):
+        self.writer = writer
+        self.inner = inner
+        if registry is None:
+            from .telemetry import metrics_registry
+
+            registry = metrics_registry()
+        self.registry = registry
+
+    def report_checking(self, data: ReportData) -> None:
+        if self.inner is not None:
+            self.inner.report_checking(data)
+        if data.done:
+            snap = self.registry.snapshot()
+            self.writer.write(
+                "Telemetry " + json.dumps(snap, sort_keys=True, default=str)
+                + "\n"
+            )
+
+    def report_discoveries(self, discoveries) -> None:
+        if self.inner is not None:
+            self.inner.report_discoveries(discoveries)
+
+    def delay(self) -> float:
+        return self.inner.delay() if self.inner is not None else 1.0

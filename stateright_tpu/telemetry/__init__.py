@@ -1,0 +1,59 @@
+"""Unified observability layer for every checker backend.
+
+Two halves, both process-local and always importable:
+
+- ``metrics``: a registry of named counters, gauges, and log-scale
+  histograms with cheap ``inc``/``set``/``observe`` calls and a
+  ``snapshot() -> dict`` for reporters and benches.
+- ``trace``: span and instant events with monotonic timestamps, an
+  always-on in-memory ring buffer, an opt-in JSONL sink, and a Chrome
+  trace-event exporter (loadable in Perfetto / ``chrome://tracing``),
+  plus an optional ``jax.profiler`` bridge so host spans line up with
+  XLA device traces.
+
+The quantities GPU model-checking studies show must be observed *during*
+runs — frontier width per wave, dedup hit-rate, hash-set load factor —
+flow through here from every backend (host BFS/DFS, on-demand,
+simulation, the TPU wave/drain loops, and the sharded mesh checker).
+"""
+
+from .instruments import BlockInstruments, WaveInstruments
+from .metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    metrics_registry,
+)
+from .trace import (
+    JsonlSink,
+    Tracer,
+    chrome_trace,
+    chrome_trace_from_jsonl,
+    device_annotation,
+    device_step_annotation,
+    get_tracer,
+    instant,
+    span,
+    write_chrome_trace,
+)
+
+__all__ = [
+    "BlockInstruments",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "JsonlSink",
+    "MetricsRegistry",
+    "Tracer",
+    "WaveInstruments",
+    "chrome_trace",
+    "chrome_trace_from_jsonl",
+    "device_annotation",
+    "device_step_annotation",
+    "get_tracer",
+    "instant",
+    "metrics_registry",
+    "span",
+    "write_chrome_trace",
+]

@@ -1,0 +1,105 @@
+"""Shared per-backend instrument bundles.
+
+Every device checker (single-device and sharded) records the same
+quantities per host-visible wave/drain, and every host engine the same
+quantities per block; these bundles are the ONE place that shape lives so
+the backends cannot drift (the per-wave span args here are the shape
+``scripts/trace_summary.py`` and the acceptance trace consume).
+"""
+
+from __future__ import annotations
+
+from .metrics import MetricsRegistry, metrics_registry
+
+
+class WaveInstruments:
+    """Counters/gauges/histogram for a device checker's wave loop, named
+    ``<prefix>.waves`` etc., plus the canonical per-wave recording."""
+
+    def __init__(self, prefix: str, registry: MetricsRegistry = None):
+        reg = registry if registry is not None else metrics_registry()
+        self.waves = reg.counter(f"{prefix}.waves")
+        self.drains = reg.counter(f"{prefix}.drains")
+        self.generated = reg.counter(f"{prefix}.states_generated")
+        self.unique = reg.counter(f"{prefix}.states_unique")
+        self.table_grows = reg.counter(f"{prefix}.table_grows")
+        self.occupancy = reg.gauge(f"{prefix}.hashset_occupancy")
+        self.capacity = reg.gauge(f"{prefix}.hashset_capacity")
+        self.depth = reg.gauge(f"{prefix}.max_depth")
+        self.warmup = reg.gauge(f"{prefix}.warmup_seconds")
+        self.wave_new = reg.histogram(f"{prefix}.wave_new_unique")
+
+    def record(
+        self,
+        span,
+        *,
+        frontier: int,
+        generated: int,
+        n_new: int,
+        occupancy: float,
+        capacity: int,
+        max_depth: int,
+        count_wave: bool = True,
+        observe: bool = True,
+        phase: str = None,
+        **extra,
+    ) -> None:
+        """One wave's (or drain-aggregate's) telemetry: registry updates
+        plus — when the caller holds a span open over it — the per-wave
+        args. Drain aggregates pass ``count_wave=False``/``observe=False``
+        and account their wave tally separately (the final unconsumed
+        wave is consumed, and counted, host-side)."""
+        if count_wave:
+            self.waves.inc()
+        self.generated.inc(generated)
+        self.unique.inc(n_new)
+        if observe:
+            self.wave_new.observe(n_new)
+        self.occupancy.set(occupancy)
+        self.capacity.set(capacity)
+        self.depth.set(max_depth)
+        if span is not None:
+            if phase is not None:
+                extra["phase"] = phase
+            span.set(
+                frontier=frontier,
+                generated=generated,
+                new_unique=n_new,
+                dedup_hit_rate=(
+                    (generated - n_new) / generated if generated else 0.0
+                ),
+                occupancy=occupancy,
+                capacity=capacity,
+                max_depth=max_depth,
+                **extra,
+            )
+
+
+class BlockInstruments:
+    """Counters/histogram for a host engine's per-block loop
+    (``bfs.block`` / ``dfs.block`` / ``on_demand.block``)."""
+
+    def __init__(self, prefix: str, registry: MetricsRegistry = None):
+        reg = registry if registry is not None else metrics_registry()
+        self.blocks = reg.counter(f"{prefix}.blocks")
+        self.evaluated = reg.counter(f"{prefix}.states_evaluated")
+        self.generated = reg.counter(f"{prefix}.states_generated")
+        self.block_width = reg.histogram(f"{prefix}.block_states")
+
+    def record(
+        self, span, *, evaluated: int, generated: int, max_depth: int,
+        unique_total: int,
+    ) -> None:
+        """Closes out one block: registry updates + the block span's
+        late-bound args (the span is entered by the caller around the
+        block body and exited here)."""
+        self.blocks.inc()
+        self.evaluated.inc(evaluated)
+        self.generated.inc(generated)
+        self.block_width.observe(evaluated)
+        span.set(
+            evaluated=evaluated,
+            generated=generated,
+            max_depth=max_depth,
+            unique_total=unique_total,
+        ).__exit__(None, None, None)

@@ -1,0 +1,266 @@
+"""Trace events: spans + instants, ring buffer, JSONL sink, Chrome export.
+
+Events are recorded in Chrome trace-event form directly (``name``, ``ph``,
+``ts``/``dur`` in microseconds, ``pid``/``tid``, ``args``) so the JSONL
+sink is a plain line-per-event stream and the Perfetto export is just an
+envelope around the same dicts. Timestamps come from
+``time.perf_counter_ns`` — monotonic, so span durations are exact even
+across wall-clock adjustments.
+
+The in-memory ring buffer is always on (bounded, last-N events) and the
+no-sink path is the fast path: one small dict + a deque append per event.
+Per-state recording is a design error — backends emit one span per
+wave/block/drain, keeping overhead well under the always-on budget
+(asserted by ``tests/test_telemetry.py``'s overhead micro-benchmark).
+
+``device_annotation``/``device_step_annotation`` bridge host spans into
+``jax.profiler`` annotations so they line up with XLA device traces in
+TensorBoard/Perfetto; they degrade to no-ops when jax (or its profiler)
+is unavailable, keeping this module importable everywhere.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Dict, IO, Iterable, List, Optional
+
+RING_CAPACITY = 4096
+
+
+def _now_us() -> float:
+    return time.perf_counter_ns() / 1000.0
+
+
+class JsonlSink:
+    """Appends each event as one JSON line; thread-safe, flushed per
+    write so a killed run still leaves a parseable prefix."""
+
+    def __init__(self, path_or_file):
+        if hasattr(path_or_file, "write"):
+            self._file: IO[str] = path_or_file
+            self._owns = False
+            self.path = getattr(path_or_file, "name", None)
+        else:
+            self._file = open(path_or_file, "w")
+            self._owns = True
+            self.path = os.fspath(path_or_file)
+        self._lock = threading.Lock()
+
+    def write_event(self, event: Dict) -> None:
+        line = json.dumps(event, separators=(",", ":"))
+        try:
+            with self._lock:
+                self._file.write(line + "\n")
+                self._file.flush()
+        except ValueError:
+            # remove_sink() can close this file while another checker's
+            # worker thread is mid-_emit with a stale reference; telemetry
+            # must never turn that race into a worker_error on an
+            # otherwise healthy run. The event survives in the ring.
+            pass
+
+    def close(self) -> None:
+        if self._owns:
+            with self._lock:
+                self._file.close()
+
+
+class _Span:
+    """Context manager for one complete ("X") event. ``args`` is mutable
+    until exit — callers fill in quantities only known at span end (a
+    wave's new-unique count, dedup rate, occupancy)."""
+
+    __slots__ = ("_tracer", "name", "args", "_t0")
+
+    def __init__(self, tracer: "Tracer", name: str, args: Dict):
+        self._tracer = tracer
+        self.name = name
+        self.args = args
+
+    def set(self, **kwargs) -> "_Span":
+        self.args.update(kwargs)
+        return self
+
+    def __enter__(self) -> "_Span":
+        self._t0 = _now_us()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        t1 = _now_us()
+        self._tracer._emit(
+            {
+                "name": self.name,
+                "ph": "X",
+                "ts": self._t0,
+                "dur": t1 - self._t0,
+                "pid": os.getpid(),
+                "tid": threading.get_ident(),
+                "args": self.args,
+            }
+        )
+
+
+class _NullSpan:
+    """The disabled-tracer span: still yields an object with the span
+    surface so call sites stay unconditional."""
+
+    __slots__ = ("args",)
+
+    def __init__(self):
+        self.args: Dict = {}
+
+    def set(self, **kwargs) -> "_NullSpan":
+        return self
+
+    def __enter__(self) -> "_NullSpan":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        pass
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class Tracer:
+    def __init__(self, ring_capacity: int = RING_CAPACITY):
+        self._ring: deque = deque(maxlen=ring_capacity)
+        self._sinks: List[JsonlSink] = []
+        self.enabled = True
+
+    # -- recording ---------------------------------------------------------
+
+    def span(self, name: str, **args) -> "_Span":
+        """``with tracer.span("tpu_bfs.wave", frontier=F) as sp: ...`` —
+        the span records begin/duration on exit; fill late-bound args via
+        ``sp.set(...)`` or ``sp.args[...]``."""
+        if not self.enabled:
+            return _NULL_SPAN
+        return _Span(self, name, args)
+
+    def instant(self, name: str, **args) -> None:
+        """A point event (scope: thread)."""
+        if not self.enabled:
+            return
+        self._emit(
+            {
+                "name": name,
+                "ph": "i",
+                "ts": _now_us(),
+                "s": "t",
+                "pid": os.getpid(),
+                "tid": threading.get_ident(),
+                "args": args,
+            }
+        )
+
+    def _emit(self, event: Dict) -> None:
+        self._ring.append(event)
+        for sink in self._sinks:
+            sink.write_event(event)
+
+    # -- sinks and inspection ----------------------------------------------
+
+    def add_sink(self, sink) -> "JsonlSink":
+        """Attaches a sink (anything with ``write_event``); a str/path
+        argument is wrapped in a ``JsonlSink``. Returns the sink."""
+        if not hasattr(sink, "write_event"):
+            sink = JsonlSink(sink)
+        self._sinks.append(sink)
+        return sink
+
+    def remove_sink(self, sink, close: bool = True) -> None:
+        if sink in self._sinks:
+            self._sinks.remove(sink)
+        if close and hasattr(sink, "close"):
+            sink.close()
+
+    def events(self) -> List[Dict]:
+        """The ring buffer's current contents, oldest first."""
+        return list(self._ring)
+
+    def clear(self) -> None:
+        self._ring.clear()
+
+
+_default_tracer = Tracer()
+
+
+def get_tracer() -> Tracer:
+    """THE process-local tracer every backend records into."""
+    return _default_tracer
+
+
+def span(name: str, **args) -> "_Span":
+    return _default_tracer.span(name, **args)
+
+
+def instant(name: str, **args) -> None:
+    _default_tracer.instant(name, **args)
+
+
+# -- Chrome trace-event export (Perfetto / chrome://tracing) ---------------
+
+
+def chrome_trace(events: Optional[Iterable[Dict]] = None) -> Dict:
+    """Wraps events (default: the default tracer's ring buffer) in the
+    Chrome trace-event JSON envelope. The object form (not the bare
+    array) is what Perfetto's JSON importer documents."""
+    if events is None:
+        events = _default_tracer.events()
+    return {
+        "traceEvents": list(events),
+        "displayTimeUnit": "ms",
+    }
+
+
+def chrome_trace_from_jsonl(path) -> Dict:
+    """Re-envelopes a JSONL sink file (one event per line) as Chrome
+    trace JSON. Unparseable trailing lines (a killed run's partial
+    write) are skipped, never fatal."""
+    events = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                events.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue
+    return chrome_trace(events)
+
+
+def write_chrome_trace(path, events: Optional[Iterable[Dict]] = None) -> None:
+    with open(path, "w") as f:
+        json.dump(chrome_trace(events), f)
+
+
+# -- jax.profiler bridge ---------------------------------------------------
+
+
+def device_annotation(name: str):
+    """A ``jax.profiler.TraceAnnotation`` so the host span shows up in
+    XLA device traces; a no-op context when jax is unavailable."""
+    try:
+        import jax
+
+        return jax.profiler.TraceAnnotation(name)
+    except Exception:  # noqa: BLE001 - profiler optional by design
+        return contextlib.nullcontext()
+
+
+def device_step_annotation(name: str, step: int):
+    """A ``jax.profiler.StepTraceAnnotation`` (step-aligned variant used
+    by the per-wave/per-drain loops); no-op without jax."""
+    try:
+        import jax
+
+        return jax.profiler.StepTraceAnnotation(name, step_num=step)
+    except Exception:  # noqa: BLE001
+        return contextlib.nullcontext()
