@@ -386,6 +386,17 @@ def _run_leg(leg: str, pin_cpu: bool):
     out["telemetry"] = {
         k: v for k, v in snap.items() if not isinstance(v, dict)
     }
+    # Occupancy-adaptive dispatch record (BENCH_r06+ trajectory): the
+    # per-rung dispatch histogram, the run's last frontier fill /
+    # compaction ratio, and whether buffer donation was active.
+    out["bucket_dispatch"] = {
+        k.rsplit(".", 1)[1]: v
+        for k, v in snap.items()
+        if ".bucket_dispatch." in k
+    }
+    out["frontier_fill"] = snap.get("tpu_bfs.frontier_fill")
+    out["compaction_ratio"] = snap.get("tpu_bfs.compaction_ratio")
+    out["donation"] = bool(getattr(checker, "donation_enabled", False))
     want = spec.get("expect_discovery")
     if want is not None:
         path = checker.discoveries().get(want)
@@ -706,12 +717,28 @@ def _main_benched():
         "device": primary["device"],
     }
     line["run_mode"] = primary.get("run_mode", "in_bench")
+    # Occupancy-adaptive dispatch trajectory (BENCH_r06+): the primary
+    # leg's bucket histogram + frontier fill + donation status ride the
+    # headline line, per-leg ones ride the loop below.
+    if primary.get("bucket_dispatch"):
+        line["bucket_dispatch"] = primary["bucket_dispatch"]
+    if primary.get("frontier_fill") is not None:
+        line["frontier_fill"] = round(primary["frontier_fill"], 4)
+    line["donation"] = primary.get("donation", False)
     for leg in ("paxos", "ilock", "abd3o", "raft5", "paxos3", "scr4"):
         if leg in results:
             line[f"{leg}_rate"] = round(results[leg]["rate"], 1)
             line[f"{leg}_unique"] = results[leg]["unique"]
             line[f"{leg}_wall_s"] = round(results[leg]["wall_s"], 2)
             line[f"{leg}_device"] = results[leg]["device"]
+            if results[leg].get("bucket_dispatch"):
+                line[f"{leg}_bucket_dispatch"] = results[leg][
+                    "bucket_dispatch"
+                ]
+            if results[leg].get("frontier_fill") is not None:
+                line[f"{leg}_frontier_fill"] = round(
+                    results[leg]["frontier_fill"], 4
+                )
             if results[leg].get("advisory"):
                 line[f"{leg}_advisory"] = True
             if "ttc_s" in results[leg]:

@@ -58,6 +58,7 @@ def wave_rows(events):
                 "dedup_pct": 100.0 * args.get("dedup_hit_rate", 0.0),
                 "occupancy_pct": 100.0 * args.get("occupancy", 0.0),
                 "waves": args.get("waves", 1),
+                "bucket": args.get("bucket", ""),
                 "phase": args.get("phase", ""),
             }
         )
@@ -67,14 +68,16 @@ def wave_rows(events):
 def print_table(rows, out=sys.stdout):
     header = (
         f"{'#':>4} {'span':<18} {'ms':>9} {'waves':>5} {'frontier':>8} "
-        f"{'generated':>10} {'new':>9} {'dedup%':>7} {'occ%':>6} phase"
+        f"{'bucket':>7} {'generated':>10} {'new':>9} {'dedup%':>7} "
+        f"{'occ%':>6} phase"
     )
     out.write(header + "\n")
     out.write("-" * len(header) + "\n")
     for i, r in enumerate(rows, 1):
         out.write(
             f"{i:>4} {r['name']:<18} {r['ms']:>9.2f} {r['waves']:>5} "
-            f"{str(r['frontier']):>8} {r['generated']:>10} "
+            f"{str(r['frontier']):>8} {str(r['bucket']):>7} "
+            f"{r['generated']:>10} "
             f"{r['new_unique']:>9} {r['dedup_pct']:>7.1f} "
             f"{r['occupancy_pct']:>6.1f} {r['phase']}\n"
         )

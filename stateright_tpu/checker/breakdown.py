@@ -11,6 +11,15 @@ dispatch overhead the fused wave does not pay, so the fused wave is timed
 too and reported alongside (stage sums exceeding the fused time = the
 overhead, not a lie).
 
+Occupancy-adaptive dispatch is mirrored as well: the representative
+frontier's live lanes are counted, compacted to a dense prefix, and the
+wave is attributed at the smallest ladder bucket holding them — the exact
+dispatch the checker runs. ``fused_wave_ms`` is therefore the bucketed
+wave; ``fused_wave_fixed_ms`` keeps the fixed-F_max figure the pre-bucket
+rounds reported (their ratio is the dispatch win), ``bucket_fused_ms``
+times every ladder rung, and ``compact_ms`` prices the compaction pass
+the bucketed dispatch adds.
+
 The output feeds ``bench.py``'s breakdown fields: per-stage milliseconds,
 bytes-per-state, and a roofline attainment figure against the chip's HBM
 peak — the judgeability half of VERDICT r03 #1. The reference's analog is
@@ -105,14 +114,18 @@ def measure_wave_breakdown(
     warmup_waves: int = 6,
     iters: int = 20,
     wave_dedup: str | None = None,
+    bucket_ladder: int | None = None,
 ) -> Dict:
     """Stage-split timings + cost analysis on a representative wave.
 
     Runs the staged pipeline for ``warmup_waves`` real waves from the
     model's initial states (so the measured frontier holds real states at
-    a realistic fill), then times each stage. Returns a dict of
-    per-stage seconds, the fused-wave seconds, per-wave cost-analysis
-    totals, and roofline attainment when the device peak is known.
+    a realistic fill), compacts the live lanes and selects the ladder
+    bucket exactly like the checker's dispatch, then times each stage at
+    that bucket. Returns a dict of per-stage seconds, the bucketed and
+    fixed-width fused-wave seconds, per-rung fused times, per-wave
+    cost-analysis totals, and roofline attainment when the device peak is
+    known.
 
     ``wave_dedup`` must match the configuration being attributed
     (``TpuBfsChecker``'s knob): "sort" measures the sort_dedup + sorted
@@ -120,22 +133,38 @@ def measure_wave_breakdown(
     duplicate-tolerant ``insert`` stage the scatter path actually runs —
     attributing a sort the measured rate never executes would mislead
     the next optimization round. None resolves to the same backend
-    default the checker uses (``default_wave_dedup``).
+    default the checker uses (``default_wave_dedup``). ``bucket_ladder``
+    mirrors the checker knob (None = the default ladder, 0 = fixed
+    width).
     """
-    if wave_dedup is None:
-        from .tpu import default_wave_dedup
+    from .tpu import (
+        _AUTO_BUCKET_MIN_F,
+        _DEFAULT_BUCKET_STEPS,
+        bucket_for,
+        bucket_ladder_widths,
+        default_wave_dedup,
+    )
 
+    if wave_dedup is None:
         wave_dedup = default_wave_dedup(jax.default_backend())
     if wave_dedup not in ("sort", "scatter"):
         raise ValueError(f"wave_dedup must be 'sort' or 'scatter': {wave_dedup!r}")
     F = 1 << (frontier_capacity - 1).bit_length()
+    if bucket_ladder is None:
+        # Mirror the checker's auto rule so the attributed dispatch is
+        # the dispatched dispatch.
+        bucket_ladder = (
+            _DEFAULT_BUCKET_STEPS if F >= _AUTO_BUCKET_MIN_F else 0
+        )
+    ladder = bucket_ladder_widths(F, bucket_ladder)
     A = model.packed_action_count()
-    B = F * A
     conditions = model.packed_conditions()
     fp_fn = model.packed_fingerprint
     # Attribute the pipeline the checker actually runs: models providing
     # the fps hooks get the fingerprint-only wave (expand_fps / insert /
-    # materialize), everything else the materializing wave.
+    # materialize), everything else the materializing wave. Every stage
+    # below is shape-polymorphic in the frontier width (widths are taken
+    # from the inputs), so one definition serves every ladder rung.
     use_fps = (
         type(model).packed_expand_fps is not BatchableModel.packed_expand_fps
         and type(model).packed_take is not BatchableModel.packed_take
@@ -154,15 +183,16 @@ def measure_wave_breakdown(
 
     def fingerprint(cand):
         flat = jax.tree_util.tree_map(
-            lambda x: x.reshape((B,) + x.shape[2:]), cand
+            lambda x: x.reshape((-1,) + x.shape[2:]), cand
         )
         return jax.vmap(fp_fn)(flat)
 
     def sort_dedup(chi, clo, flat_valid):
+        b = chi.shape[0]
         shi = jnp.where(flat_valid, chi, _U32_MAX)
         slo = jnp.where(flat_valid, clo, _U32_MAX)
         shi, slo, sidx = jax.lax.sort(
-            (shi, slo, jnp.arange(B, dtype=jnp.int32)), num_keys=2
+            (shi, slo, jnp.arange(b, dtype=jnp.int32)), num_keys=2
         )
         uniq = jnp.concatenate(
             [jnp.ones((1,), bool), (shi[1:] != shi[:-1]) | (slo[1:] != slo[:-1])]
@@ -176,21 +206,23 @@ def measure_wave_breakdown(
         return hashset_insert_unsorted(table, chi, clo, flat_valid)
 
     def compact_refs(fresh, sidx):
-        """F-compacted source references of the fresh lanes — the wave's
-        next-frontier selection (beyond-F fresh lanes go to later
-        segments/chunks in the real checker). Shared slot math for both
-        pipelines."""
+        """Width-compacted source references of the fresh lanes — the
+        wave's next-frontier selection (beyond-width fresh lanes go to
+        later segments/chunks in the real checker). Shared slot math for
+        both pipelines."""
+        b = fresh.shape[0]
+        f_out = b // A
         pos = jnp.cumsum(fresh.astype(jnp.int32)) - 1
-        out_slot = jnp.where(fresh & (pos < F), pos, F)
-        src_idx = jnp.zeros((F,), jnp.int32).at[out_slot].set(
+        out_slot = jnp.where(fresh & (pos < f_out), pos, f_out)
+        src_idx = jnp.zeros((f_out,), jnp.int32).at[out_slot].set(
             sidx, mode="drop"
         )
-        taken = jnp.zeros((F,), bool).at[out_slot].set(fresh, mode="drop")
+        taken = jnp.zeros((f_out,), bool).at[out_slot].set(fresh, mode="drop")
         return src_idx, taken
 
     def compact(cand, sidx, fresh):
         flat = jax.tree_util.tree_map(
-            lambda x: x.reshape((B,) + x.shape[2:]), cand
+            lambda x: x.reshape((-1,) + x.shape[2:]), cand
         )
         src_idx, taken = compact_refs(fresh, sidx)
         new_states = jax.tree_util.tree_map(lambda x: x[src_idx], flat)
@@ -199,67 +231,60 @@ def measure_wave_breakdown(
     def expand_fps(states, mask):
         hi, lo, v = jax.vmap(model.packed_expand_fps)(states)
         v = v & mask[:, None]
-        return hi.reshape(B), lo.reshape(B), v.reshape(B)
-
-    def sort_dedup_flat(chi, clo, flat_valid):
-        shi = jnp.where(flat_valid, chi, _U32_MAX)
-        slo = jnp.where(flat_valid, clo, _U32_MAX)
-        shi, slo, sidx = jax.lax.sort(
-            (shi, slo, jnp.arange(B, dtype=jnp.int32)), num_keys=2
-        )
-        uniq = jnp.concatenate(
-            [jnp.ones((1,), bool), (shi[1:] != shi[:-1]) | (slo[1:] != slo[:-1])]
-        )
-        return shi, slo, sidx, flat_valid[sidx] & uniq
-
-    def insert_scatter_flat(table, chi, clo, flat_valid):
-        return hashset_insert_unsorted(table, chi, clo, flat_valid)
-
-    def fps_compact_refs(fresh, sidx):
-        """F-compacted (parent, action) references of the fresh lanes —
-        the wave's next-frontier selection (beyond-F fresh lanes go to
-        later segments/chunks in the real checker)."""
-        pos = jnp.cumsum(fresh.astype(jnp.int32)) - 1
-        out_slot = jnp.where(fresh & (pos < F), pos, F)
-        src_idx = jnp.zeros((F,), jnp.int32).at[out_slot].set(
-            sidx, mode="drop"
-        )
-        taken = jnp.zeros((F,), bool).at[out_slot].set(fresh, mode="drop")
-        return src_idx, taken
+        return hi.reshape(-1), lo.reshape(-1), v.reshape(-1)
 
     def materialize(states, src_idx):
-        """One F-lane segment of fresh-child materialization (the real
-        pipeline runs ceil(n_new / F) of these per wave)."""
+        """One frontier-width segment of fresh-child materialization (the
+        real pipeline runs ceil(n_new / width) of these per wave)."""
         parents = jax.tree_util.tree_map(lambda x: x[src_idx // A], states)
         return jax.vmap(model.packed_take)(parents, src_idx % A)
+
+    def compact_dispatch(states, mask):
+        """The checker's pre-dispatch live-lane compaction (_compact_chunk):
+        a stable cumsum scatter of the frontier rows to a dense prefix —
+        the overhead the bucketed dispatch adds over fixed width."""
+        f_in = mask.shape[0]
+        pos = jnp.cumsum(mask.astype(jnp.int32)) - 1
+        dest = jnp.where(mask, pos, f_in)
+
+        def scat(x):
+            z = jnp.zeros((f_in,) + x.shape[1:], x.dtype)
+            return z.at[dest].set(x, mode="drop")
+
+        out = jax.tree_util.tree_map(scat, states)
+        new_mask = jnp.arange(f_in, dtype=jnp.int32) < mask.sum(
+            dtype=jnp.int32
+        )
+        return out, new_mask
 
     def fused(table, states, mask):
         # The props result is returned (not dropped) so XLA cannot
         # dead-code-eliminate the predicate out of the fused timing.
+        b = mask.shape[0] * A
         pv = props(states, mask)
         if use_fps:
             chi, clo, cvalid = expand_fps(states, mask)
             if wave_dedup == "scatter":
-                table, fresh, _found, _pending = insert_scatter_flat(
+                table, fresh, _found, _pending = insert_scatter(
                     table, chi, clo, cvalid
                 )
-                sidx = jnp.arange(B, dtype=jnp.int32)
+                sidx = jnp.arange(b, dtype=jnp.int32)
             else:
-                shi, slo, sidx, active = sort_dedup_flat(chi, clo, cvalid)
+                shi, slo, sidx, active = sort_dedup(chi, clo, cvalid)
                 table, fresh, _found, _pending = insert(
                     table, shi, slo, active
                 )
-            src_idx, taken = fps_compact_refs(fresh, sidx)
+            src_idx, taken = compact_refs(fresh, sidx)
             new_states = materialize(states, src_idx)
             return table, new_states, taken, pv.any()
         cand, cvalid = expand(states, mask)
-        cvalid = cvalid.reshape(B)  # (F, A) grid -> flat lanes, like _wave
+        cvalid = cvalid.reshape(b)  # (F, A) grid -> flat lanes, like _wave
         chi, clo = fingerprint(cand)
         if wave_dedup == "scatter":
             table, fresh, _found, _pending = insert_scatter(
                 table, chi, clo, cvalid
             )
-            sidx = jnp.arange(B, dtype=jnp.int32)
+            sidx = jnp.arange(b, dtype=jnp.int32)
         else:
             shi, slo, sidx, active = sort_dedup(chi, clo, cvalid)
             table, fresh, _found, _pending = insert(table, shi, slo, active)
@@ -275,10 +300,9 @@ def measure_wave_breakdown(
     j_compact = jax.jit(compact)
     j_fused = jax.jit(fused)
     j_expand_fps = jax.jit(expand_fps)
-    j_sort_flat = jax.jit(sort_dedup_flat)
-    j_insert_scatter_flat = jax.jit(insert_scatter_flat)
     j_materialize = jax.jit(materialize)
-    j_refs = jax.jit(fps_compact_refs)
+    j_refs = jax.jit(compact_refs)
+    j_compact_dispatch = jax.jit(compact_dispatch)
 
     # Seed: initial states padded to the frontier width.
     init = model.packed_init_states()
@@ -309,41 +333,50 @@ def measure_wave_breakdown(
             break  # space exhausted; measure on the last non-empty wave
         table, states, mask = nxt[0], nxt[1], nxt[2]
 
-    frontier_fill = float(mask.sum()) / F
+    live = int(mask.sum())
+    frontier_fill = live / F
+    # The checker's dispatch: compact live lanes to a dense prefix, pick
+    # the smallest ladder bucket that holds them, slice the frontier to it.
+    bucket = bucket_for(ladder, max(1, live))
+    c_states, c_mask = j_compact_dispatch(states, mask)
+    states_w = jax.tree_util.tree_map(lambda x: x[:bucket], c_states)
+    mask_w = c_mask[:bucket]
+    B = bucket * A
+
     materialize_segments = None
     if use_fps:
-        fhi, flo, fvalid = j_expand_fps(states, mask)
+        fhi, flo, fvalid = j_expand_fps(states_w, mask_w)
         stages = {
-            "expand_fps": (j_expand_fps, (states, mask)),
-            "properties": (j_props, (states, mask)),
+            "expand_fps": (j_expand_fps, (states_w, mask_w)),
+            "properties": (j_props, (states_w, mask_w)),
         }
         if wave_dedup == "scatter":
-            _, fresh_f, _, _ = j_insert_scatter_flat(table, fhi, flo, fvalid)
+            _, fresh_f, _, _ = j_insert_scatter(table, fhi, flo, fvalid)
             sidx_f = jnp.arange(B, dtype=jnp.int32)
             stages["insert"] = (
-                j_insert_scatter_flat,
+                j_insert_scatter,
                 (table, fhi, flo, fvalid),
             )
         else:
-            shi, slo, sidx_f, active_f = j_sort_flat(fhi, flo, fvalid)
+            shi, slo, sidx_f, active_f = j_sort(fhi, flo, fvalid)
             fresh_f = active_f
-            stages["sort_dedup"] = (j_sort_flat, (fhi, flo, fvalid))
+            stages["sort_dedup"] = (j_sort, (fhi, flo, fvalid))
             stages["insert"] = (j_insert, (table, shi, slo, active_f))
         src_idx_f, _ = j_refs(fresh_f, sidx_f)
         n_new_rep = int(fresh_f.sum())
-        # The checker materializes fresh lanes in F-wide segments; the
-        # timed stage is ONE segment, and the per-wave totals scale by the
-        # representative wave's segment count.
-        materialize_segments = max(1, -(-n_new_rep // F))
-        stages["materialize"] = (j_materialize, (states, src_idx_f))
+        # The checker materializes fresh lanes in frontier-width segments;
+        # the timed stage is ONE segment, and the per-wave totals scale by
+        # the representative wave's segment count.
+        materialize_segments = max(1, -(-n_new_rep // bucket))
+        stages["materialize"] = (j_materialize, (states_w, src_idx_f))
     else:
-        cand, cvalid = j_expand(states, mask)
+        cand, cvalid = j_expand(states_w, mask_w)
         cvalid = cvalid.reshape(B)  # flat lanes, matching the fused wave
         chi, clo = j_fp(cand)
 
         stages = {
-            "expand": (j_expand, (states, mask)),
-            "properties": (j_props, (states, mask)),
+            "expand": (j_expand, (states_w, mask_w)),
+            "properties": (j_props, (states_w, mask_w)),
             "fingerprint": (j_fp, (cand,)),
         }
         if wave_dedup == "scatter":
@@ -362,6 +395,10 @@ def measure_wave_breakdown(
         "frontier_capacity": F,
         "action_count": A,
         "frontier_fill": round(frontier_fill, 4),
+        "live_lanes": live,
+        "bucket": bucket,
+        "bucket_ladder": ladder,
+        "compaction_ratio": round(live / bucket, 4) if bucket else 0.0,
         "device": jax.devices()[0].platform,
         "device_kind": jax.devices()[0].device_kind,
         "wave_dedup": wave_dedup,
@@ -371,8 +408,9 @@ def measure_wave_breakdown(
     total_bytes = 0.0
     total_flops = 0.0
     if materialize_segments is not None:
-        # materialize stage numbers are per F-lane segment; totals below
-        # scale them by this count (the representative wave's real cost).
+        # materialize stage numbers are per bucket-wide segment; totals
+        # below scale them by this count (the representative wave's real
+        # cost).
         out["materialize_segments_per_wave"] = materialize_segments
         out["pipeline"] = "fps"
     for name, (fn, args) in stages.items():
@@ -390,16 +428,52 @@ def measure_wave_breakdown(
             out["stage_cost"][name] = cost
             total_bytes += cost["bytes"]
             total_flops += cost["flops"]
+    # The compaction pass the bucketed dispatch adds (full-width frontier
+    # in, dense prefix out) — the overhead the tier-1 micro-benchmark
+    # budget-tests against the fixed-width wave.
+    out["compact_ms"] = round(
+        _time_stage(j_compact_dispatch, (states, mask), iters) * 1e3, 4
+    )
+    # THE dispatched wave: fused at the selected bucket (acceptance
+    # metric), alongside the fixed-width wave the pre-bucket rounds
+    # measured and the full per-rung ladder.
     out["fused_wave_ms"] = round(
+        _time_stage(j_fused, (table, states_w, mask_w), iters) * 1e3, 4
+    )
+    out["fused_wave_fixed_ms"] = round(
         _time_stage(j_fused, (table, states, mask), iters) * 1e3, 4
     )
-    fused_compiled = j_fused.lower(table, states, mask).compile()
+    bucket_fused = {}
+    for w in ladder:
+        if w == bucket:
+            bucket_fused[str(w)] = out["fused_wave_ms"]
+        elif w == F:
+            bucket_fused[str(w)] = out["fused_wave_fixed_ms"]
+        else:
+            bucket_fused[str(w)] = round(
+                _time_stage(
+                    j_fused,
+                    (
+                        table,
+                        jax.tree_util.tree_map(
+                            lambda x: x[:w], c_states
+                        ),
+                        c_mask[:w],
+                    ),
+                    iters,
+                )
+                * 1e3,
+                4,
+            )
+    out["bucket_fused_ms"] = bucket_fused
+    fused_compiled = j_fused.lower(table, states_w, mask_w).compile()
     fused_traffic = _memory_traffic(fused_compiled)
 
-    # Normalize: candidates processed per wave is the honest denominator
-    # for "bytes per state" (every candidate is fingerprinted/sorted
-    # whether or not it turns out fresh).
+    # Normalize: candidates processed per dispatched wave is the honest
+    # denominator for "bytes per state" (every candidate lane in the
+    # bucket is fingerprinted/sorted whether or not it turns out fresh).
     out["candidates_per_wave"] = B
+    out["candidates_per_wave_fixed"] = F * A
     if total_bytes:
         # Op-level (pre-fusion) accounting: an upper bound that charges
         # every elementwise op a full memory round-trip.

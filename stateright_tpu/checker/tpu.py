@@ -69,6 +69,36 @@ _DEPTH_INF = (1 << 31) - 1
 _U32_MAX = np.uint32(0xFFFFFFFF)  # numpy: keeps module import backend-free
 # Grow the device hash set before load factor can exceed this.
 _MAX_LOAD = 0.55
+# Smallest bucket in the occupancy-adaptive wave ladder: one packed tile
+# (8 sublanes) — narrower dispatches are dominated by fixed launch cost.
+_MIN_BUCKET = 8
+# Default ladder depth: F_max/16 … F_max (4 power-of-two halvings).
+_DEFAULT_BUCKET_STEPS = 4
+# The ladder auto-engages (bucket_ladder=None) only at this frontier
+# capacity or above: below it a full wave is already microseconds of
+# masked waste, so the rung compiles could never pay for themselves.
+# Pass bucket_ladder explicitly to force either way.
+_AUTO_BUCKET_MIN_F = 512
+
+
+def bucket_ladder_widths(f_max: int, steps: int) -> list:
+    """The descending power-of-two wave-width ladder for a checker with
+    frontier capacity ``f_max``: ``[F_max, F_max/2, …]`` down to
+    ``max(F_max >> steps, _MIN_BUCKET)``. ``steps=0`` disables bucketing
+    (a single fixed-width rung). Shared by the checkers and the
+    breakdown mirror so the measured ladder is the dispatched ladder."""
+    floor = max(min(f_max, _MIN_BUCKET), f_max >> max(0, steps))
+    return [f_max >> i for i in range(steps + 1) if (f_max >> i) >= floor]
+
+
+def bucket_for(widths, live: int) -> int:
+    """The smallest ladder width that holds ``live`` lanes (``widths``
+    descending; the widest rung is returned when nothing smaller fits)."""
+    chosen = widths[0]
+    for w in widths[1:]:
+        if live <= w:
+            chosen = w
+    return chosen
 
 
 def packed_model_digest(model, action_count: int) -> str:
@@ -389,7 +419,12 @@ class TpuBfsChecker(Checker):
 
     ``frontier_capacity`` caps lanes per wave (larger frontiers split into
     chunks); ``table_capacity`` is the initial device hash-set size (grows
-    by doubling + rehash).
+    by doubling + rehash). ``bucket_ladder`` is the occupancy-adaptive
+    dispatch depth: the number of power-of-two bucket widths below
+    ``F_max`` a wave may dispatch at (None auto-selects 4 →
+    ``F_max/16 … F_max`` when ``F_max >= 512`` and fixed width below
+    that, where rung compiles cannot pay for themselves; 0 forces fixed
+    width); see README "Performance tuning".
     """
 
     def __init__(
@@ -408,6 +443,7 @@ class TpuBfsChecker(Checker):
         hashset_impl="xla",
         wave_dedup=None,
         expand_fps=None,
+        bucket_ladder=None,
     ):
         model = options.model
         if not isinstance(model, BatchableModel):
@@ -433,10 +469,27 @@ class TpuBfsChecker(Checker):
         self._ebit: Dict[int, int] = {pi: b for b, pi in enumerate(eventually)}
         self._ebits0 = sum(1 << b for b in self._ebit.values())
         self._A = model.packed_action_count()
-        # Every wave runs at exactly this width (short chunks are masked),
-        # so the expansion kernel compiles once per run — recompilation
-        # through the device tunnel costs tens of seconds per shape.
+        # Waves dispatch on a power-of-two bucket ladder (F_max down to
+        # F_max >> bucket_ladder): each chunk runs at the smallest bucket
+        # holding its live lanes, so wave cost scales with occupancy
+        # instead of capacity. Every rung compiles once per table shape
+        # (the AOT cache below is keyed on (bucket, table_capacity)), so
+        # steady state never recompiles — recompilation through the
+        # device tunnel costs tens of seconds per shape.
+        # ``bucket_ladder``: number of halvings below F_max (None = the
+        # default ladder, 0 = fixed-width dispatch).
         self._F_max = _pow2ceil(frontier_capacity)
+        if bucket_ladder is None:
+            bucket_ladder = (
+                _DEFAULT_BUCKET_STEPS
+                if self._F_max >= _AUTO_BUCKET_MIN_F
+                else 0
+            )
+        if bucket_ladder < 0:
+            raise ValueError(
+                f"bucket_ladder must be >= 0, got {bucket_ladder}"
+            )
+        self._buckets = bucket_ladder_widths(self._F_max, bucket_ladder)
         self._capacity = table_capacity
         # Visited-set insert kernel for the sorted wave batches: "xla"
         # (gather/scatter probing, ops/hashset.py) or "pallas" (tile-sweep
@@ -585,18 +638,44 @@ class TpuBfsChecker(Checker):
             self._use_fps = True
         else:
             self._use_fps = False
-        self._jit_wave = jax.jit(self._wave)
-        self._wave_exec = {}  # table capacity -> AOT-compiled wave
-        self._jit_drain = jax.jit(self._deep_drain)
+        # Buffer donation kills the per-call copy of the big operands
+        # (hash table, pool ring): every donated argnum below is audited —
+        # the caller never touches the donated buffer after the call
+        # (it rebinds to the returned one). The checkpoint/export reads
+        # (_jit_pool_export, _jit_take) are deliberately NOT donated: the
+        # exported pool / padded arrays must survive the call (checkpoints
+        # happen mid-run; _jit_take slices the same padded array
+        # repeatedly).
+        self._jit_wave = jax.jit(self._wave, donate_argnums=(0,))
+        # (bucket width, table capacity) -> AOT-compiled wave: the ladder
+        # rungs and table growths each compile once, steady state replays.
+        self._wave_exec = {}
+        # Deep-drain executables, one per ladder rung actually visited:
+        # ``_drain_jits`` holds the width-closed jit objects, ``_drain_exec``
+        # the AOT-compiled executables keyed (width, table rows, pool
+        # capacity) — compiles are lazy, so a run that never leaves F_max
+        # pays for exactly one drain compile.
+        self._drain_jits = {}
+        self._drain_exec = {}
         self._jit_pool_zero = jax.jit(self._pool_zero, static_argnums=(0,))
-        self._jit_pool_push = jax.jit(self._pool_push)
+        # The ring is rebound to the returned one; the pushed chunk's
+        # buffers cannot alias the ring (scatter), so donating them would
+        # only trade a copy for an unusable-donation warning.
+        self._jit_pool_push = jax.jit(self._pool_push, donate_argnums=(0,))
         self._jit_pool_export = jax.jit(self._pool_export)
-        self._jit_init = jax.jit(self._init_wave)
+        self._jit_init = jax.jit(self._init_wave, donate_argnums=(0,))
         self._jit_take = jax.jit(self._take, static_argnums=(2,))
         self._jit_finish = jax.jit(self._finish, static_argnums=(2,))
         self._jit_materialize = jax.jit(self._materialize)
-        self._jit_rehash = jax.jit(self._rehash)
+        # Only the destination table (arg 1) can alias the output; the old
+        # table has a different shape and is freed by the caller's rebind.
+        self._jit_rehash = jax.jit(self._rehash, donate_argnums=(1,))
         self._jit_fp_single = jax.jit(self._fp_fn)
+        # (in_width, bucket) -> jitted live-lane compaction (see
+        # _compact_chunk).
+        self._compact_exec = {}
+        self.donation_enabled = True
+        self._last_dispatch = None  # (bucket, live) of the last chunk wave
 
         self._handles = [
             threading.Thread(target=self._run, name="tpu-bfs", daemon=True)
@@ -823,14 +902,15 @@ class TpuBfsChecker(Checker):
             pool, head, count, chunk, chunk["mask"], self._pool_capacity
         )
 
-    def _pool_push_fps(self, pool, head, count, new, parent_states, n_new):
+    def _pool_push_fps(self, pool, head, count, new, parent_states, n_new, width):
         """Ring push for the fps wave: fresh lanes arrive as (parent,
         action) references (``new["src_idx"]``, prefix-compacted), and
-        their states are materialized straight into the ring in F_max-wide
-        segments inside a dynamic-trip-count loop — real traffic is
-        ``n_new`` children, never the F × A candidate grid, and no B-wide
-        state buffer exists between the wave and the ring."""
-        A, F = self._A, self._F_max
+        their states are materialized straight into the ring in
+        ``width``-wide segments inside a dynamic-trip-count loop — real
+        traffic is ``n_new`` children, never the F × A candidate grid,
+        and no B-wide state buffer exists between the wave and the ring.
+        ``width`` is the producing wave's lane width (the drain's bucket)."""
+        A, F = self._A, width
         B = F * A
         PC = self._pool_capacity
         lanes = jnp.arange(B, dtype=jnp.int32)
@@ -868,10 +948,12 @@ class TpuBfsChecker(Checker):
         )
         return {"states": pstates, **meta}, count + n_new
 
-    def _pool_take(self, pool, head, count):
-        """Dequeues up to ``F_max`` lanes from the ring head as a frontier."""
+    def _pool_take(self, pool, head, count, width=None):
+        """Dequeues up to ``width`` (default ``F_max``) lanes from the
+        ring head as a frontier."""
         return ring_take(
-            pool, head, count, self._pool_capacity, self._F_max
+            pool, head, count, self._pool_capacity,
+            self._F_max if width is None else width,
         )
 
     def _pool_export(self, pool, head, count):
@@ -890,10 +972,10 @@ class TpuBfsChecker(Checker):
         )
         return pool, jnp.int32(0), count
 
-    def _deep_drain(self, table, pool, head, count, undiscovered, budget, depth_cap):
+    def _deep_drain(self, width, table, pool, head, count, undiscovered, budget, depth_cap):
         """Runs the BFS inside one device ``while_loop``: each iteration
         pushes the previous wave's fresh states into the FIFO ring, dequeues
-        the next ``F_max`` lanes, and expands them. The loop exits to the
+        the next ``width`` lanes, and expands them. The loop exits to the
         host only when a wave is *unconsumable* device-side: the parent-fp
         log is full, the visited set needs growing, an undiscovered property
         hit, the ring would overflow, or a hash probe overflowed. Host round
@@ -901,11 +983,22 @@ class TpuBfsChecker(Checker):
         per-wave floor on locally-attached chips) are thus amortized over
         entire BFS phases instead of paid per wave (SURVEY §7-5c).
 
+        ``width`` (static) is the drain's wave width — a rung of the
+        occupancy-adaptive bucket ladder, so a sparse pending frontier
+        drains at e.g. ``F_max/16`` lanes per wave instead of burning
+        ``F_max``-wide expand grids on masked padding. The host picks the
+        rung from the exact ring count at each drain entry (lazily
+        AOT-compiling new rungs), and the loop additionally exits when the
+        ring backlog outgrows the rung (``count > width`` with a wider
+        rung available) so a growing frontier promotes itself back up the
+        ladder. The popped lane sequence is width-independent (strict
+        FIFO), so results are bit-identical across rungs.
+
         Returns the final (unconsumed) wave output, the frontier that
         produced it (for overflow retry), the ring, accumulated totals for
         the consumed waves, and their (child, parent[, key]) log entries.
         """
-        F, A = self._F_max, self._A
+        F, A = width, self._A
         B = F * A
         L = self._drain_log_capacity
         PC = self._pool_capacity
@@ -923,7 +1016,7 @@ class TpuBfsChecker(Checker):
                 depth_cap,
             )
 
-        frontier0, head, count = self._pool_take(pool, head, count)
+        frontier0, head, count = self._pool_take(pool, head, count, F)
         out0 = wave_of(table, frontier0)
         zl = jnp.zeros((L,), jnp.uint32)
         log0 = {
@@ -950,6 +1043,9 @@ class TpuBfsChecker(Checker):
             # drain runs at most max_drain_waves waves total (the cap backs
             # the checkpoint-durability guarantee).
             "waves": jnp.int32(1),
+            # Live lanes dispatched (the drain's compaction-ratio
+            # numerator; the denominator is waves × width, host-side).
+            "live_sum": frontier0["mask"].sum(dtype=jnp.int32),
         }
 
         def cond(c):
@@ -961,6 +1057,11 @@ class TpuBfsChecker(Checker):
                 ok &= ~(o["prop_hit"] & undiscovered).any()
             ok &= c["log_n"] + n_new <= L
             ok &= c["count"] + n_new <= PC
+            if F < self._F_max:
+                # Promote-exit: a backlog beyond one more wave means the
+                # frontier outgrew this rung — hand back to the host,
+                # which re-enters at the bucket the exact count selects.
+                ok &= c["count"] <= F
             # Insert budget must survive consuming this wave plus another
             # full worst-case wave (B candidates).
             ok &= c["budget"] - n_new >= B
@@ -1009,6 +1110,7 @@ class TpuBfsChecker(Checker):
                     new,
                     c["frontier"]["states"],
                     n_new,
+                    F,
                 )
             else:
                 pool, count = self._pool_push(
@@ -1024,7 +1126,7 @@ class TpuBfsChecker(Checker):
                         "mask": valid,
                     },
                 )
-            frontier, head, count = self._pool_take(pool, c["head"], count)
+            frontier, head, count = self._pool_take(pool, c["head"], count, F)
             return {
                 "pool": pool,
                 "head": head,
@@ -1038,6 +1140,8 @@ class TpuBfsChecker(Checker):
                 "max_depth": jnp.maximum(c["max_depth"], o["max_depth"]),
                 "budget": c["budget"] - n_new,
                 "waves": c["waves"] + 1,
+                "live_sum": c["live_sum"]
+                + frontier["mask"].sum(dtype=jnp.int32),
             }
 
         res = jax.lax.while_loop(cond, body, carry)
@@ -1052,6 +1156,7 @@ class TpuBfsChecker(Checker):
                 res["max_depth"],
                 res["waves"],
                 res["count"],
+                res["live_sum"],
             ]
         )
         cols = ["child_hi", "child_lo", "parent_hi", "parent_lo"]
@@ -1155,14 +1260,84 @@ class TpuBfsChecker(Checker):
         else:
             self._explore_waves(table, queue, depth_cap, t_start)
 
+    def _compact_chunk(self, chunk, width):
+        """Gathers a chunk's live lanes into a dense prefix and narrows it
+        to ``width`` (the chosen bucket), so masked padding lanes never
+        reach the expand grid. The cumsum scatter is stable — live lanes
+        keep their relative order, so in-wave dedup tie-breaks (first
+        claim wins by lane order) pick the same winner as the fixed-width
+        dispatch and the bucketed path stays bit-identical."""
+        key = (chunk["hi"].shape[0], width)
+        fn = self._compact_exec.get(key)
+        if fn is None:
+
+            def compact(c):
+                mask = c["mask"]
+                pos = jnp.cumsum(mask.astype(jnp.int32)) - 1
+                # Scatter straight into the bucket-wide buffer (the
+                # chosen bucket holds every live lane by construction;
+                # the < width guard only drops lanes a torn mask could
+                # produce) — a full-width scatter sliced afterwards
+                # would still write O(F_max) bytes per leaf.
+                dest = jnp.where(mask & (pos < width), pos, width)
+
+                def scat(x):
+                    z = jnp.zeros((width,) + x.shape[1:], x.dtype)
+                    return z.at[dest].set(x, mode="drop")
+
+                out = {
+                    k: (
+                        jax.tree_util.tree_map(scat, v)
+                        if k == "states"
+                        else scat(v)
+                    )
+                    for k, v in c.items()
+                    if k != "mask"
+                }
+                out["mask"] = (
+                    jnp.arange(width, dtype=jnp.int32)
+                    < mask.sum(dtype=jnp.int32)
+                )
+                return out
+
+            fn = jax.jit(compact)
+            self._compact_exec[key] = fn
+        return fn(chunk)
+
     def _call_wave(self, table, chunk, depth_cap):
-        """Runs one wave through an AOT-compiled executable (keyed by table
-        capacity — the only shape that varies at runtime). Explicit AOT
-        keeps warmup accounting exact: a compile triggered mid-run (table
-        growth changes the shape) is measured and moved into
-        ``warmup_seconds`` instead of polluting the steady-state window.
-        During the initial pre-first-result window ``warmup_seconds`` is
-        still None and the caller's own stamp covers the compile."""
+        """Runs one wave through an AOT-compiled executable keyed on
+        (bucket width, table capacity) — the only shapes that vary at
+        runtime. The chunk is dispatched at the smallest ladder bucket
+        holding its live lanes (compacted to a dense prefix first), so
+        wave cost scales with occupancy instead of F_max. Returns
+        ``(wave_out, dispatched_chunk)`` — the caller must enqueue /
+        materialize against the *dispatched* chunk, whose lane indices the
+        wave's parent references point into.
+
+        Explicit AOT keeps warmup accounting exact: a compile triggered
+        mid-run (table growth or a new ladder rung) is measured and moved
+        into ``warmup_seconds`` instead of polluting the steady-state
+        window. During the initial pre-first-result window
+        ``warmup_seconds`` is still None and the caller's own stamp covers
+        the compile."""
+        f_in = chunk["hi"].shape[0]
+        if (
+            len(self._buckets) > 1
+            and f_in == self._F_max
+            # A table-growth retry re-dispatches the SAME logical wave
+            # (same chunk, _last_dispatch already recorded): selecting and
+            # counting again would double the bucket_dispatch histogram
+            # and re-pay the blocking live-count pull.
+            and self._last_dispatch is None
+        ):
+            # One tiny transfer to learn the live count; the wave-at-a-time
+            # path already syncs per wave (np.asarray of the stats vector),
+            # so this adds a second scalar-sized pull, not a new regime.
+            live = int(np.asarray(chunk["mask"].sum()))
+            width = bucket_for(self._buckets, live)
+            if width < f_in:
+                chunk = self._compact_chunk(chunk, width)
+            self._record_dispatch(width, live)
         args = (
             table,
             chunk["states"],
@@ -1185,22 +1360,35 @@ class TpuBfsChecker(Checker):
             if self.warmup_seconds is not None:
                 self.warmup_seconds += time.perf_counter() - t0
                 self._wi.warmup.set(self.warmup_seconds)
-        return exe(*args)
+        return exe(*args), chunk
+
+    def _record_dispatch(self, width, live):
+        """One bucketed dispatch's telemetry (gauges + per-rung counter);
+        the live/width pair is kept for the wave span's args."""
+        self._last_dispatch = (width, live)
+        self._wi.bucket.set(width)
+        self._wi.bucket_dispatch(width)
+        self._wi.compaction.set(live / width if width else 0.0)
+        self._wi.frontier_fill.set(live / self._F_max)
 
     def _consume_wave(self, table, wave, chunk, queue, depth_cap, span=None):
         """Applies one wave output host-side (counters, discoveries, log,
         requeue), retrying the producing frontier after table growth until
-        no probe overflows. Returns the updated table. ``span`` (optional,
-        a telemetry span covering this wave) is filled with the per-wave
-        quantities the acceptance trace carries."""
+        no probe overflows. Returns ``(table, wave_new)`` — the updated
+        table and the wave's fresh-unique count (the deep loop uses it as
+        the exact live size of the chunks spilled into the host queue).
+        ``span`` (optional, a telemetry span covering this wave) is filled
+        with the per-wave quantities the acceptance trace carries."""
         props = self._properties
-        B = chunk["hi"].shape[0] * self._A
         attempt = 0
         generated = 0
         wave_new = 0
+        self._last_dispatch = None
         while True:
             if wave is None:
-                wave = self._call_wave(table, chunk, depth_cap)
+                # Rebind to the dispatched (bucketed/compacted) chunk: the
+                # wave's parent references index into ITS lanes.
+                wave, chunk = self._call_wave(table, chunk, depth_cap)
             table = wave["table"]
             # Single host transfer per wave: [generated, n_new, overflow,
             # max_depth, any_prop_hit?]; per-property fingerprints are
@@ -1226,18 +1414,25 @@ class TpuBfsChecker(Checker):
             self._unique_count += n_new
             if n_new:
                 self._log_wave(wave, n_new)
-                self._enqueue(queue, wave, n_new, B, chunk)
+                # Lane width of the DISPATCHED chunk (the bucket), so the
+                # enqueue padding target scales with the bucket instead of
+                # re-inflating every sparse wave's output to F_max × A.
+                self._enqueue(
+                    queue, wave, n_new, chunk["hi"].shape[0] * self._A,
+                    chunk,
+                )
             if not int(stats[2]):
                 self._record_wave_metrics(
                     span, chunk["hi"].shape[0], generated, wave_new
                 )
-                return table
+                return table, wave_new
             table = self._grow_table(table, self._capacity * 2)
             attempt += 1
             wave = None
 
     def _record_wave_metrics(self, span, frontier, generated, n_new):
         """One wave's telemetry (the shared bundle does the recording)."""
+        bucket, live = self._last_dispatch or (None, None)
         self._wi.record(
             span,
             frontier=frontier,
@@ -1247,6 +1442,8 @@ class TpuBfsChecker(Checker):
             capacity=self._capacity,
             max_depth=self._max_depth,
             phase="warmup" if self.warmup_seconds is None else "steady",
+            bucket=bucket,
+            compaction_ratio=(live / bucket if bucket else None),
         )
 
     def _explore_waves(self, table, queue, depth_cap, t_start):
@@ -1283,7 +1480,7 @@ class TpuBfsChecker(Checker):
             with self._tracer.span(
                 "tpu_bfs.wave", wave=chunks
             ) as sp, device_step_annotation("tpu_bfs.wave", chunks):
-                table = self._consume_wave(
+                table, _ = self._consume_wave(
                     table, None, chunk, queue, depth_cap, span=sp
                 )
             if self.warmup_seconds is None:
@@ -1301,9 +1498,21 @@ class TpuBfsChecker(Checker):
         head = jnp.int32(0)
         count = jnp.int32(0)
         pool_count = 0  # host view; exact after each drain, bounded after pushes
+        # Exact pending live lanes (ring + spilled queue) — the bucket
+        # selector's input. None until the first drain exit: the first
+        # drain always runs at F_max, so a run that finishes in one drain
+        # (every small space) compiles exactly one rung, and the ramp-up
+        # phase never ladder-climbs through the narrow rungs' compiles.
+        live_est = None
+        # Consecutive-entry votes per rung: a NEW rung's drain compile is
+        # only paid once the same rung is selected on two consecutive
+        # entries. A ramp-up phase sweeps through each narrow live count
+        # once (votes never accumulate → it stays on already-compiled
+        # rungs), while a persistent sparse regime selects the same rung
+        # every entry and adapts on its second drain.
+        rung_votes = {}
         drains = 0
         last_checkpoint = time.perf_counter()
-        compiled = False
         while True:
             if len(self._discoveries_fp) == len(props):
                 break
@@ -1360,36 +1569,57 @@ class TpuBfsChecker(Checker):
                     (1 << 31) - 1 - B,
                 )
             )
-            if not compiled:
-                # Compile ahead of the first real call so warmup measures
-                # pure compilation: a single deep drain can run the whole
-                # exploration, so "time until the first result returned"
-                # (the wave path's proxy) would fold exploration into
-                # warmup and corrupt steady-state rates.
-                with self._tracer.span("tpu_bfs.compile", kind="drain"):
-                    self._jit_drain.lower(
-                        table,
-                        pool,
-                        head,
-                        count,
-                        jnp.asarray(undiscovered),
-                        budget,
-                        depth_cap,
-                    ).compile()
-                compiled = True
-                if self.warmup_seconds is None:
-                    self._set_warmup(time.perf_counter() - t_start)
-            drain_span = self._tracer.span("tpu_bfs.drain", drain=drains)
-            with drain_span, device_step_annotation("tpu_bfs.drain", drains):
-                res = self._jit_drain(
-                    table,
-                    pool,
-                    head,
-                    count,
-                    jnp.asarray(undiscovered),
-                    budget,
-                    depth_cap,
+            # Ladder rung for this drain: the smallest bucket holding the
+            # exact pending-live count (F_max for the first drain — see
+            # live_est above). A sparse steady state drains at e.g.
+            # F_max/16 lanes per wave; the promote-exit inside the drain
+            # hands back control if the frontier outgrows the rung.
+            width = self._F_max
+            if live_est is not None and len(self._buckets) > 1:
+                want = bucket_for(
+                    self._buckets, max(1, min(live_est, self._F_max))
                 )
+                if want in self._drain_jits or want == self._F_max:
+                    width = want
+                    rung_votes = {}
+                else:
+                    votes = rung_votes.get(want, 0) + 1
+                    rung_votes = {want: votes}
+                    if votes >= 2:
+                        width = want
+                    else:
+                        # Not yet worth a compile: the narrowest rung
+                        # already compiled that still holds the load
+                        # (F_max as the floor fallback).
+                        width = min(
+                            (
+                                w
+                                for w in self._drain_jits
+                                if w >= want
+                            ),
+                            default=self._F_max,
+                        )
+            args = (
+                table,
+                pool,
+                head,
+                count,
+                jnp.asarray(undiscovered),
+                budget,
+                depth_cap,
+            )
+            # Compile ahead of the real call so warmup measures pure
+            # compilation: a single deep drain can run the whole
+            # exploration, so "time until the first result returned"
+            # (the wave path's proxy) would fold exploration into
+            # warmup and corrupt steady-state rates. Mid-run compiles
+            # (new rung, grown table/ring) are measured into warmup too.
+            exe = self._drain_exe(width, args, t_start)
+            drain_span = self._tracer.span(
+                "tpu_bfs.drain", drain=drains, bucket=width
+            )
+            with drain_span, device_step_annotation("tpu_bfs.drain", drains):
+                res = exe(*args)
                 dstats = np.asarray(res["drain_stats"])
                 log_n = int(dstats[0])
                 self._state_count += int(dstats[1])
@@ -1402,6 +1632,22 @@ class TpuBfsChecker(Checker):
                 # _consume_wave call below, hence waves - 1 here.
                 self._wi.drains.inc()
                 self._wi.waves.inc(max(int(dstats[4]) - 1, 0))
+                # Bucket accounting for the drain's waves: every wave in
+                # this drain ran at ``width`` lanes; the compaction ratio
+                # is live lanes over dispatched lanes, the frontier fill
+                # live lanes over F_max capacity.
+                waves_n = int(dstats[4])
+                live_sum = int(dstats[6])
+                self._wi.bucket.set(width)
+                self._wi.bucket_dispatch(width, waves_n)
+                compaction = (
+                    live_sum / (waves_n * width) if waves_n else None
+                )
+                if compaction is not None:
+                    self._wi.compaction.set(compaction)
+                    self._wi.frontier_fill.set(
+                        live_sum / (waves_n * self._F_max)
+                    )
                 self._wi.record(
                     drain_span,
                     frontier=self._F_max,
@@ -1412,9 +1658,11 @@ class TpuBfsChecker(Checker):
                     max_depth=self._max_depth,
                     count_wave=False,
                     observe=False,
-                    waves=int(dstats[4]),
+                    waves=waves_n,
                     log_n=log_n,
                     ring_count=int(dstats[5]),
+                    bucket=width,
+                    compaction_ratio=compaction,
                 )
             pool, head, count = res["pool"], res["head"], res["count"]
             pool_count = int(dstats[5])
@@ -1430,10 +1678,44 @@ class TpuBfsChecker(Checker):
             # way; its fresh chunks spill into the host queue and are fed
             # back into the ring on the next loop pass.
             with self._tracer.span("tpu_bfs.wave", drain=drains) as sp:
-                table = self._consume_wave(
+                table, spilled = self._consume_wave(
                     table, res["out"], res["frontier"], queue, depth_cap,
                     span=sp,
                 )
+            # Exact pending live lanes: the ring's count plus the final
+            # wave's fresh spill — the next drain's bucket selector input.
+            live_est = pool_count + spilled
+
+    def _drain_exe(self, width, args, t_start):
+        """The AOT-compiled deep drain for one ladder rung, keyed on
+        (width, table rows, pool capacity) so table/ring growth recompiles
+        are explicit and measured. The first compile stamps warmup; later
+        compiles (new rung or grown shapes) are added to it, keeping the
+        steady-state window honest."""
+        key = (width, args[0].shape[0], self._pool_capacity)
+        exe = self._drain_exec.get(key)
+        if exe is None:
+            jit_fn = self._drain_jits.get(width)
+            if jit_fn is None:
+
+                def fn(*a, _w=width):
+                    return self._deep_drain(_w, *a)
+
+                jit_fn = jax.jit(fn, donate_argnums=(0, 1))
+                self._drain_jits[width] = jit_fn
+            t0 = time.perf_counter()
+            with self._tracer.span(
+                "tpu_bfs.compile", kind="drain", bucket=width,
+                table_capacity=key[1],
+            ):
+                exe = jit_fn.lower(*args).compile()
+            self._drain_exec[key] = exe
+            if self.warmup_seconds is None:
+                self._set_warmup(time.perf_counter() - t_start)
+            else:
+                self.warmup_seconds += time.perf_counter() - t0
+                self._wi.warmup.set(self.warmup_seconds)
+        return exe
 
     def _export_pool_chunks(self, pool, head, count):
         """The ring contents as F_max-wide host chunks (for checkpoints)."""

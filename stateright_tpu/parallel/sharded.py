@@ -69,8 +69,12 @@ from ..telemetry import WaveInstruments, device_step_annotation, get_tracer
 from .base_mesh import default_mesh
 from ..checker.base import Checker
 from ..checker.tpu import (
+    _AUTO_BUCKET_MIN_F,
+    _DEFAULT_BUCKET_STEPS,
     _make_key_fn,
     atomic_pickle,
+    bucket_for,
+    bucket_ladder_widths,
     checkpoint_header,
     sym_key_scheme,
     validate_checkpoint_header,
@@ -110,6 +114,10 @@ class ShardedTpuBfsChecker(Checker):
     global chunk is ``n_devices ×`` that); ``table_capacity_per_device``
     is each shard's initial hash-set size (grows by doubling + local
     rehash — keys never change owner, so rehash needs no communication).
+    ``bucket_ladder`` is the occupancy-adaptive chunk-dispatch depth
+    (power-of-two rungs below ``F_loc``; None auto-selects 4 when
+    ``F_loc >= 512``, else fixed width; 0 forces fixed width); the
+    wave-at-a-time path shrinks global chunks to ``n × bucket``.
     """
 
     def __init__(
@@ -125,6 +133,7 @@ class ShardedTpuBfsChecker(Checker):
         max_drain_waves=100_000,
         drain_log_factor=8,
         pool_factor=16,
+        bucket_ladder=None,
     ):
         model = options.model
         if not isinstance(model, BatchableModel):
@@ -155,6 +164,23 @@ class ShardedTpuBfsChecker(Checker):
         self._A = model.packed_action_count()
         self._F_loc = _pow2ceil(frontier_per_device)
         self._G = n * self._F_loc  # global frontier chunk width
+        # Occupancy-adaptive chunk dispatch (wave-at-a-time path): global
+        # chunks shrink to ``n × bucket`` where bucket is the smallest
+        # per-device ladder rung holding the pending rows — the host pool
+        # count is exact, so no transfer is needed to pick it. The deep
+        # drain keeps fixed F_loc waves (its ring pops already compact
+        # live lanes to a per-device dense prefix).
+        if bucket_ladder is None:
+            bucket_ladder = (
+                _DEFAULT_BUCKET_STEPS
+                if self._F_loc >= _AUTO_BUCKET_MIN_F
+                else 0
+            )
+        if bucket_ladder < 0:
+            raise ValueError(
+                f"bucket_ladder must be >= 0, got {bucket_ladder}"
+            )
+        self._buckets = bucket_ladder_widths(self._F_loc, bucket_ladder)
         # Probing masks with (capacity - 1): non-pow2 would address only a
         # subset of rows.
         self._cap_loc = _pow2ceil(table_capacity_per_device)
@@ -203,6 +229,12 @@ class ShardedTpuBfsChecker(Checker):
         # mesh spans processes and device arrays are only partially
         # addressable from each host — host pulls must allgather.
         self._mp = jax.process_count() > 1
+        # Buffer donation on the jitted collectives mirrors TpuBfsChecker:
+        # the hash-table shards and pool rings are rebound to the returned
+        # arrays by every caller, so the per-call copy of the largest
+        # operands disappears. The export path (_jit_ring_export) is
+        # deliberately NOT donated — checkpoints read the rings mid-run
+        # and the pool must survive the call.
         self._jit_wave = jax.jit(
             shard_map(
                 self._wave_local,
@@ -210,7 +242,8 @@ class ShardedTpuBfsChecker(Checker):
                 in_specs=(P("fp"),) * 7 + (P(),),
                 out_specs=P("fp"),
                 check_vma=False,
-            )
+            ),
+            donate_argnums=(0,),
         )
         self._wave_exec = {}  # (local capacity, chunk width) -> AOT wave
         self._jit_insert = jax.jit(
@@ -220,8 +253,11 @@ class ShardedTpuBfsChecker(Checker):
                 in_specs=(P("fp"),) * 4,
                 out_specs=P("fp"),
                 check_vma=False,
-            )
+            ),
+            donate_argnums=(0,),
         )
+        # Only the destination table (arg 1) can alias the output; the
+        # old, smaller table is freed by the caller's rebind.
         self._jit_rehash = jax.jit(
             shard_map(
                 self._rehash_local,
@@ -229,7 +265,8 @@ class ShardedTpuBfsChecker(Checker):
                 in_specs=(P("fp"), P("fp")),
                 out_specs=P("fp"),
                 check_vma=False,
-            )
+            ),
+            donate_argnums=(1,),
         )
         self._jit_deep_drain = jax.jit(
             shard_map(
@@ -238,7 +275,8 @@ class ShardedTpuBfsChecker(Checker):
                 in_specs=(P("fp"),) * 4 + (P(), P(), P()),
                 out_specs=P("fp"),
                 check_vma=False,
-            )
+            ),
+            donate_argnums=(0, 1),
         )
         self._jit_ring_push = jax.jit(
             shard_map(
@@ -247,7 +285,8 @@ class ShardedTpuBfsChecker(Checker):
                 in_specs=(P("fp"),) * 4,
                 out_specs=P("fp"),
                 check_vma=False,
-            )
+            ),
+            donate_argnums=(0,),
         )
         self._jit_ring_export = jax.jit(
             shard_map(
@@ -278,6 +317,7 @@ class ShardedTpuBfsChecker(Checker):
         # stateright_tpu.telemetry); occupancy is global across shards.
         self._tracer = get_tracer()
         self._wi = WaveInstruments("sharded_bfs")
+        self.donation_enabled = True
 
         self._handles = [
             threading.Thread(target=self._run, name="sharded-tpu-bfs", daemon=True)
@@ -954,7 +994,25 @@ class ShardedTpuBfsChecker(Checker):
                         int((self._unique_count + B_glob) / (_MAX_LOAD * n))
                     ),
                 )
-            chunk = self._pool_take(G)
+            # Occupancy-adaptive dispatch: the host pool count is exact
+            # (numpy rows), so the global chunk shrinks to n × the
+            # smallest per-device ladder rung holding the pending rows —
+            # a sparse frontier expands an n×bucket grid, not n×F_loc.
+            # _pool_take's round-robin interleave then gives every shard a
+            # dense live-lane prefix at that width.
+            got = min(self._pool_count, G)
+            width = G
+            bucket = None
+            if len(self._buckets) > 1:
+                bucket = bucket_for(
+                    self._buckets, max(1, -(-got // n))
+                )
+                width = n * bucket
+                self._wi.bucket.set(bucket)
+                self._wi.bucket_dispatch(bucket)
+                self._wi.compaction.set(got / width)
+                self._wi.frontier_fill.set(got / G)
+            chunk = self._pool_take(width)
             dev = self._put_chunk(chunk)
 
             attempt = 0
@@ -995,7 +1053,14 @@ class ShardedTpuBfsChecker(Checker):
                         break
                     table = self._grow_table(table, self._cap_loc * 2)
                     attempt += 1
-                self._record_wave_metrics(sp, G, wave_generated, wave_new)
+                self._record_wave_metrics(
+                    sp,
+                    width,
+                    wave_generated,
+                    wave_new,
+                    bucket=bucket,
+                    compaction_ratio=(got / width if bucket else None),
+                )
             if self.warmup_seconds is None:
                 self.warmup_seconds = time.perf_counter() - self._t_start
                 self._wi.warmup.set(self.warmup_seconds)
@@ -1477,8 +1542,10 @@ class ShardedTpuBfsChecker(Checker):
         self._unique_count += total
         if not total:
             return total
-        B = self._G * self._A // self._n
         hi = self._pull(wave["new_hi"])
+        # Per-device candidate-lane width of THIS wave (bucketed chunks
+        # dispatch below G, so the width is the wave's, not the config's).
+        B = hi.shape[0] // self._n
         lo = self._pull(wave["new_lo"])
         ebits = self._pull(wave["new_ebits"])
         depth = self._pull(wave["new_depth"])
@@ -1508,7 +1575,10 @@ class ShardedTpuBfsChecker(Checker):
         )
         return total
 
-    def _record_wave_metrics(self, span, frontier, generated, n_new):
+    def _record_wave_metrics(
+        self, span, frontier, generated, n_new, bucket=None,
+        compaction_ratio=None,
+    ):
         """One host-visible wave's telemetry (the shared bundle does the
         recording; occupancy is global across the mesh's shards)."""
         self._wi.record(
@@ -1520,6 +1590,8 @@ class ShardedTpuBfsChecker(Checker):
             capacity=self._n * self._cap_loc,
             max_depth=self._max_depth,
             phase="warmup" if self.warmup_seconds is None else "steady",
+            bucket=bucket,
+            compaction_ratio=compaction_ratio,
         )
 
     def _visit_chunk(self, chunk):

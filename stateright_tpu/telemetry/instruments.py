@@ -18,6 +18,8 @@ class WaveInstruments:
 
     def __init__(self, prefix: str, registry: MetricsRegistry = None):
         reg = registry if registry is not None else metrics_registry()
+        self._prefix = prefix
+        self._registry = reg
         self.waves = reg.counter(f"{prefix}.waves")
         self.drains = reg.counter(f"{prefix}.drains")
         self.generated = reg.counter(f"{prefix}.states_generated")
@@ -28,6 +30,26 @@ class WaveInstruments:
         self.depth = reg.gauge(f"{prefix}.max_depth")
         self.warmup = reg.gauge(f"{prefix}.warmup_seconds")
         self.wave_new = reg.histogram(f"{prefix}.wave_new_unique")
+        # Occupancy-adaptive dispatch: the bucket width the last wave ran
+        # at, the live-lane fraction of that bucket (compaction ratio),
+        # and the live fraction of the configured F_max (frontier fill).
+        self.bucket = reg.gauge(f"{prefix}.wave_bucket")
+        self.compaction = reg.gauge(f"{prefix}.compaction_ratio")
+        self.frontier_fill = reg.gauge(f"{prefix}.frontier_fill")
+        # Per-bucket dispatch counters, created lazily per width so the
+        # registry only carries the ladder rungs a run actually used.
+        self._bucket_counters = {}
+
+    def bucket_dispatch(self, width: int, n: int = 1) -> None:
+        """Counts ``n`` wave dispatches at ``width`` lanes (one counter
+        per ladder rung: ``<prefix>.bucket_dispatch.<width>``)."""
+        c = self._bucket_counters.get(width)
+        if c is None:
+            c = self._registry.counter(
+                f"{self._prefix}.bucket_dispatch.{width}"
+            )
+            self._bucket_counters[width] = c
+        c.inc(n)
 
     def record(
         self,
@@ -42,13 +64,17 @@ class WaveInstruments:
         count_wave: bool = True,
         observe: bool = True,
         phase: str = None,
+        bucket: int = None,
+        compaction_ratio: float = None,
         **extra,
     ) -> None:
         """One wave's (or drain-aggregate's) telemetry: registry updates
         plus — when the caller holds a span open over it — the per-wave
         args. Drain aggregates pass ``count_wave=False``/``observe=False``
         and account their wave tally separately (the final unconsumed
-        wave is consumed, and counted, host-side)."""
+        wave is consumed, and counted, host-side). ``bucket`` /
+        ``compaction_ratio`` ride the span when the backend dispatched
+        through the occupancy-adaptive bucket ladder."""
         if count_wave:
             self.waves.inc()
         self.generated.inc(generated)
@@ -61,6 +87,10 @@ class WaveInstruments:
         if span is not None:
             if phase is not None:
                 extra["phase"] = phase
+            if bucket is not None:
+                extra["bucket"] = bucket
+            if compaction_ratio is not None:
+                extra["compaction_ratio"] = compaction_ratio
             span.set(
                 frontier=frontier,
                 generated=generated,
