@@ -1,0 +1,178 @@
+#!/usr/bin/env python
+"""Tiered-storage summary from a telemetry trace JSONL.
+
+    python scripts/storage_report.py TRACE.jsonl
+
+Reads the JSONL sink an out-of-core checker run produced (``--trace-out``
+on bench.py, or ``get_tracer().add_sink(path)`` on any run) and
+summarizes the storage tier activity: eviction/merge/spill counts and
+costs, probe batches with per-tier hit counts and latency percentiles,
+Bloom-filter effectiveness, and the final tier occupancy trajectory taken
+from the wave spans' ``storage_fps`` argument.
+
+Stdlib-only (json + argparse), same contract as ``trace_summary.py``:
+trace files outlive the runs that wrote them and must stay inspectable on
+boxes without jax.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def load_events(path):
+    """Events from a JSONL trace; unparseable lines (a killed run's
+    partial tail write) are skipped, never fatal."""
+    events = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                events.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue
+    return events
+
+
+def _pct(values, q):
+    if not values:
+        return 0.0
+    vals = sorted(values)
+    idx = min(len(vals) - 1, int(q * (len(vals) - 1) + 0.5))
+    return vals[idx]
+
+
+def _span_kind(name):
+    """The storage-span kind, or None. Matches any backend prefix
+    (``tpu_bfs.storage.evict``, ``sharded_bfs.storage.probe``, ...)."""
+    if ".storage." not in name:
+        return None
+    kind = name.rsplit(".", 1)[1]
+    return kind if kind in ("evict", "merge", "spill", "probe") else None
+
+
+def summarize(events):
+    spans = {
+        "evict": [], "merge": [], "merge_l2": [], "spill": [], "probe": [],
+    }
+    wave_storage = []
+    for ev in events:
+        if ev.get("ph") != "X":
+            continue
+        kind = _span_kind(ev.get("name", ""))
+        if kind is not None:
+            # L2 compactions share the ".merge" span name but record
+            # tier="l2"; split them so disk-compaction cost is never
+            # attributed to L1.
+            if kind == "merge" and (ev.get("args") or {}).get("tier") == "l2":
+                kind = "merge_l2"
+            spans[kind].append(ev)
+        args = ev.get("args") or {}
+        if "storage_fps" in args:
+            wave_storage.append(args)
+
+    out = {}
+    ms = lambda ev: ev.get("dur", 0.0) / 1000.0  # noqa: E731
+    for kind in ("evict", "merge", "merge_l2", "spill"):
+        evs = spans[kind]
+        out[kind] = {
+            "count": len(evs),
+            "fps": sum((e.get("args") or {}).get("fps", 0) for e in evs),
+            "total_ms": sum(ms(e) for e in evs),
+        }
+    probes = spans["probe"]
+    probe_ms = [ms(e) for e in probes]
+    pargs = [e.get("args") or {} for e in probes]
+    out["probe"] = {
+        "batches": len(probes),
+        "keys": sum(a.get("keys", 0) for a in pargs),
+        "hits_l1": sum(a.get("hits_l1", 0) for a in pargs),
+        "hits_l2": sum(a.get("hits_l2", 0) for a in pargs),
+        "blocks_decoded": sum(a.get("blocks_decoded", 0) for a in pargs),
+        "bloom_rejects": sum(a.get("bloom_rejects", 0) for a in pargs),
+        "total_ms": sum(probe_ms),
+        "p50_ms": _pct(probe_ms, 0.50),
+        "p99_ms": _pct(probe_ms, 0.99),
+    }
+    if wave_storage:
+        out["tier_fps_final"] = wave_storage[-1].get("storage_fps", 0)
+        out["tier_fps_peak"] = max(
+            a.get("storage_fps", 0) for a in wave_storage
+        )
+        out["stale_dropped"] = sum(
+            a.get("storage_stale", 0) for a in wave_storage
+        )
+    return out
+
+
+def print_report(s, out=sys.stdout):
+    w = out.write
+    w("tiered-storage summary\n")
+    w("----------------------\n")
+    for kind, label in (
+        ("evict", "L0 evictions"),
+        ("merge", "L1 merges"),
+        ("merge_l2", "L2 compactions"),
+        ("spill", "L2 spills"),
+    ):
+        r = s[kind]
+        w(
+            f"{label:<14} {r['count']:>6}   "
+            f"{r['fps']:>12} fps   {r['total_ms']:>9.1f} ms\n"
+        )
+    p = s["probe"]
+    w(
+        f"{'probes':<14} {p['batches']:>6}   {p['keys']:>12} keys   "
+        f"{p['total_ms']:>9.1f} ms  "
+        f"(p50 {p['p50_ms']:.2f} / p99 {p['p99_ms']:.2f} ms)\n"
+    )
+    w(
+        f"{'':14} hits: l1={p['hits_l1']} l2={p['hits_l2']}  "
+        f"bloom_rejects={p['bloom_rejects']}  "
+        f"blocks_decoded={p['blocks_decoded']}\n"
+    )
+    if "tier_fps_final" in s:
+        w(
+            f"{'tier fps':<14} final={s['tier_fps_final']}  "
+            f"peak={s['tier_fps_peak']}  "
+            f"stale_dropped={s['stale_dropped']}\n"
+        )
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="Tiered-storage summary from a telemetry trace JSONL."
+    )
+    parser.add_argument("trace", help="JSONL trace file (telemetry sink)")
+    parser.add_argument(
+        "--json", action="store_true",
+        help="emit the summary as one JSON object instead of the table",
+    )
+    args = parser.parse_args(argv)
+
+    events = load_events(args.trace)
+    if not events:
+        print(f"no events in {args.trace}", file=sys.stderr)
+        return 1
+    s = summarize(events)
+    if args.json:
+        print(json.dumps(s))
+        return 0
+    if not any(
+        s[k]["count"] for k in ("evict", "merge", "merge_l2", "spill")
+    ) and not s["probe"]["batches"]:
+        print(
+            f"{len(events)} events, no storage-tier spans "
+            "(run was not out-of-core: no hbm_budget_mib?)",
+        )
+        return 0
+    print_report(s)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

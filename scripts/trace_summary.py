@@ -6,7 +6,10 @@
 Reads the JSONL sink a checker run produced (``--trace-out`` on bench.py,
 or ``get_tracer().add_sink(path)`` on any run), prints one row per
 wave/drain span — wall ms, frontier width, generated, new-unique, dedup
-hit-rate, hash-set occupancy — and totals. ``--chrome-out`` additionally
+hit-rate, hash-set occupancy, and (out-of-core runs) the ``storage``
+column as ``stale-dropped/tier-resident-fps`` — and totals. Use
+``scripts/storage_report.py`` for the tier-level view (evictions, merges,
+spills, per-tier probe latency). ``--chrome-out`` additionally
 writes the Chrome trace-event export (load it in https://ui.perfetto.dev
 or chrome://tracing).
 
@@ -59,6 +62,13 @@ def wave_rows(events):
                 "occupancy_pct": 100.0 * args.get("occupancy", 0.0),
                 "waves": args.get("waves", 1),
                 "bucket": args.get("bucket", ""),
+                # Out-of-core runs: stale lanes the host tier probe
+                # dropped this wave / fingerprints resident in L1+L2.
+                "storage": (
+                    f"{args['storage_stale']}/{args.get('storage_fps', 0)}"
+                    if "storage_stale" in args
+                    else ""
+                ),
                 "phase": args.get("phase", ""),
             }
         )
@@ -69,7 +79,7 @@ def print_table(rows, out=sys.stdout):
     header = (
         f"{'#':>4} {'span':<18} {'ms':>9} {'waves':>5} {'frontier':>8} "
         f"{'bucket':>7} {'generated':>10} {'new':>9} {'dedup%':>7} "
-        f"{'occ%':>6} phase"
+        f"{'occ%':>6} {'storage':>13} phase"
     )
     out.write(header + "\n")
     out.write("-" * len(header) + "\n")
@@ -79,7 +89,8 @@ def print_table(rows, out=sys.stdout):
             f"{str(r['frontier']):>8} {str(r['bucket']):>7} "
             f"{r['generated']:>10} "
             f"{r['new_unique']:>9} {r['dedup_pct']:>7.1f} "
-            f"{r['occupancy_pct']:>6.1f} {r['phase']}\n"
+            f"{r['occupancy_pct']:>6.1f} {r.get('storage', ''):>13} "
+            f"{r['phase']}\n"
         )
     total_gen = sum(r["generated"] for r in rows)
     total_new = sum(r["new_unique"] for r in rows)

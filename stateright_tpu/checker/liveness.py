@@ -7,7 +7,14 @@ cycle (or rejoin previously-visited states) are documented false negatives
 (FIXMEs at ``/root/reference/src/checker/bfs.rs:285-305``, test
 ``src/checker.rs:642-659``). The default checkers here reproduce those
 semantics bit-for-bit (``tests/test_checker.py``) — counts and verdicts
-must not silently diverge from the reference.
+must not silently diverge from the reference. The known-wrong
+terminal-state merge at DAG joins is PINNED by regression tests on both
+paths: ``tests/test_liveness.py::
+test_terminal_counterexample_masked_by_dag_join_found`` (host BFS) and
+``tests/test_liveness.py::
+test_terminal_merge_at_dag_join_pinned_on_device_checker`` (device wave
+dedup) assert the default semantics still miss it and this post-pass
+still finds it.
 
 ``CheckerBuilder.complete_liveness()`` adds the missing half as a
 post-pass: for every ``eventually`` property still without a discovery,

@@ -127,7 +127,10 @@ def checkpoint_header(
     if symmetry and sym_scheme is None:
         sym_scheme = SYM_KEY_SCHEME
     return {
-        "version": 1,
+        # v2 (out-of-core tiering): adds the optional "storage" payload
+        # (L1/L2 fingerprint runs + Bloom filters). v1 checkpoints (no
+        # storage tier by construction) still restore; see MIGRATING.md.
+        "version": 2,
         "kind": kind,
         "model": type(model).__name__,
         "model_digest": packed_model_digest(model, action_count),
@@ -149,7 +152,7 @@ def validate_checkpoint_header(
     """Rejects checkpoints another checker kind, model, model configuration,
     or symmetry setting wrote. Checkpoints predating the ``kind`` field were
     written by the single-device checker (the only kind that existed)."""
-    if payload.get("version") != 1:
+    if payload.get("version") not in (1, 2):
         raise ValueError(f"unsupported checkpoint version: {payload!r}")
     found_kind = payload.get("kind", "tpu_bfs")
     if found_kind != kind:
@@ -425,6 +428,12 @@ class TpuBfsChecker(Checker):
     ``F_max/16 … F_max`` when ``F_max >= 512`` and fixed width below
     that, where rung compiles cannot pay for themselves; 0 forces fixed
     width); see README "Performance tuning".
+
+    ``hbm_budget_mib`` enables out-of-core mode: the device table is
+    hard-capped at the budget, growth past it evicts the full table to
+    host-resident delta-compressed runs (L1), and ``host_budget_mib`` /
+    ``spill_dir`` spill merged runs to disk (L2). Results are
+    bit-identical to the unbounded run; see README "Memory hierarchy".
     """
 
     def __init__(
@@ -444,6 +453,9 @@ class TpuBfsChecker(Checker):
         wave_dedup=None,
         expand_fps=None,
         bucket_ladder=None,
+        hbm_budget_mib=None,
+        host_budget_mib=None,
+        spill_dir=None,
     ):
         model = options.model
         if not isinstance(model, BatchableModel):
@@ -491,6 +503,51 @@ class TpuBfsChecker(Checker):
             )
         self._buckets = bucket_ladder_widths(self._F_max, bucket_ladder)
         self._capacity = table_capacity
+        # Out-of-core tiering (stateright_tpu.storage): ``hbm_budget_mib``
+        # hard-caps the device hash table. Growth past the cap drains the
+        # full table to host L1 runs instead of doubling (``_evict_l0``),
+        # and every later wave's L0-fresh lanes batch-probe L1/L2 at the
+        # wave's host exit — membership is the union of the tiers, so
+        # results stay bit-identical to the unbounded single-tier path
+        # (tests/test_storage_equivalence.py). See README "Memory
+        # hierarchy".
+        from ..storage import (
+            TieredVisitedStore,
+            max_table_rows_for_budget,
+            validate_budget_knobs,
+        )
+
+        validate_budget_knobs(hbm_budget_mib, host_budget_mib, spill_dir)
+        self._tier = None
+        self._max_capacity = None
+        if hbm_budget_mib is not None:
+            max_cap = max_table_rows_for_budget(hbm_budget_mib)
+            # A freshly-evicted (empty) table must absorb one worst-case
+            # wave (F_max × A candidates) under the load cap, or the
+            # grow-and-retry loop could never terminate.
+            min_cap = _pow2ceil(
+                int(self._F_max * self._A / _MAX_LOAD) + 1
+            )
+            if max_cap < min_cap:
+                raise ValueError(
+                    f"hbm_budget_mib={hbm_budget_mib} allows a device "
+                    f"table of {max_cap} rows, but one worst-case wave "
+                    f"(frontier_capacity × action_count = "
+                    f"{self._F_max * self._A} candidates) needs at least "
+                    f"{min_cap}; raise the budget or shrink "
+                    "frontier_capacity"
+                )
+            self._max_capacity = max_cap
+            self._capacity = min(self._capacity, max_cap)
+            self._tier = TieredVisitedStore(
+                host_budget_mib=host_budget_mib,
+                spill_dir=spill_dir,
+                prefix="tpu_bfs",
+            )
+        # Keys currently RESIDENT in the device table (== unique_count
+        # until the first eviction; afterwards the table holds only the
+        # working set plus re-claimed hot keys).
+        self._l0_count = 0
         # Visited-set insert kernel for the sorted wave batches: "xla"
         # (gather/scatter probing, ops/hashset.py) or "pallas" (tile-sweep
         # DMA kernel, ops/pallas_hashset.py — measure both with
@@ -1216,20 +1273,56 @@ class TpuBfsChecker(Checker):
             self._done_event.set()
 
     def _grow_table(self, table, min_capacity):
+        if (
+            self._max_capacity is not None
+            and min_capacity > self._max_capacity
+        ):
+            return self._evict_l0(table)
         capacity = self._capacity
         while capacity < min_capacity:
             capacity *= 2
-        with self._tracer.span(
-            "tpu_bfs.table_grow", from_capacity=self._capacity,
-            to_capacity=capacity,
-        ):
-            new_table, leftover = self._jit_rehash(table, hashset_new(capacity))
-        if int(leftover):
-            raise RuntimeError("device hash set rehash overflowed probe cap")
+        while True:
+            with self._tracer.span(
+                "tpu_bfs.table_grow", from_capacity=self._capacity,
+                to_capacity=capacity,
+            ):
+                new_table, leftover = self._jit_rehash(
+                    table, hashset_new(capacity)
+                )
+            if not int(leftover):
+                break
+            # A pathological key cluster can exhaust the probe cap during
+            # rehash; that costs capacity (the next doubling shortens
+            # probe chains), never the run. Under an HBM budget the next
+            # doubling may not exist — evict instead.
+            capacity *= 2
+            if (
+                self._max_capacity is not None
+                and capacity > self._max_capacity
+            ):
+                return self._evict_l0(table)
         self._capacity = capacity
         self._wi.table_grows.inc()
         self._wi.capacity.set(capacity)
         return new_table
+
+    def _evict_l0(self, table):
+        """Budget-capped growth: drains the FULL device table to a host
+        L1 run (delta-compressed, Bloom-fronted) and resets it — the
+        out-of-core alternative to doubling. Capacity settles at the
+        budget cap; the emptied table carries the hot working set from
+        here on while older fingerprints answer through the host probe."""
+        tab = np.asarray(table)
+        live = (tab[:, 0] != 0) | (tab[:, 1] != 0)
+        keys = (
+            tab[live, 0].astype(np.uint64) << np.uint64(32)
+        ) | tab[live, 1].astype(np.uint64)
+        self._tier.evict(keys)
+        self._capacity = self._max_capacity
+        self._l0_count = 0
+        self._wi.capacity.set(self._capacity)
+        self._tier.instruments.set_l0(0)
+        return hashset_new(self._capacity)
 
     def _set_warmup(self, seconds: float) -> None:
         """First-result warmup stamp, mirrored into telemetry so traces
@@ -1251,12 +1344,23 @@ class TpuBfsChecker(Checker):
         # Deep drain is off when a visitor needs per-chunk callbacks or a
         # target caps the run (overshoot would span whole drains instead of
         # single waves).
+        # A resumed out-of-core run (non-empty L1/L2) needs the per-wave
+        # host probe immediately, which only the wave path performs.
         if (
             self._max_drain_waves > 1
             and self._visitor is None
             and self._target_state_count is None
+            and (self._tier is None or self._tier.is_empty())
         ):
-            self._explore_deep(table, queue, depth_cap, t_start)
+            # A non-None return is the out-of-core handoff: the first L0
+            # eviction ended deep-drain mode and the remaining frontier
+            # continues on the wave path. Unwinding _explore_deep's frame
+            # first releases the abandoned device ring (its locals pin
+            # pool-capacity lanes of packed state in HBM otherwise).
+            handoff = self._explore_deep(table, queue, depth_cap, t_start)
+            if handoff is not None:
+                table, queue = handoff
+                self._explore_waves(table, queue, depth_cap, t_start)
         else:
             self._explore_waves(table, queue, depth_cap, t_start)
 
@@ -1383,6 +1487,7 @@ class TpuBfsChecker(Checker):
         attempt = 0
         generated = 0
         wave_new = 0
+        stale_total = 0
         self._last_dispatch = None
         while True:
             if wave is None:
@@ -1410,40 +1515,91 @@ class TpuBfsChecker(Checker):
                 if self._visitor is not None:
                     self._visit_chunk(chunk)
             n_new = int(stats[1])
-            wave_new += n_new
-            self._unique_count += n_new
-            if n_new:
-                self._log_wave(wave, n_new)
+            # Two-phase probe (out-of-core mode): the device table only
+            # vouches for the keys it currently holds — L0-fresh lanes
+            # whose key lives in an evicted L1/L2 run are STALE and must
+            # not be re-counted, re-logged, or re-expanded. One batched
+            # host probe per wave (Bloom prefilter + block binary search)
+            # during the host exit the wave path already pays.
+            keep = None
+            k64 = None
+            survivors = n_new
+            if (
+                n_new
+                and self._tier is not None
+                and not self._tier.is_empty()
+            ):
+                if self._symmetry_enabled:
+                    k64 = fp64_pairs(
+                        wave["key_hi"][:n_new], wave["key_lo"][:n_new]
+                    )
+                else:
+                    k64 = fp64_pairs(
+                        wave["new"]["hi"][:n_new],
+                        wave["new"]["lo"][:n_new],
+                    )
+                stale = self._tier.probe(k64)
+                n_stale = int(stale.sum())
+                if n_stale:
+                    keep = np.flatnonzero(~stale).astype(np.int32)
+                    survivors = n_new - n_stale
+                    stale_total += n_stale
+            self._l0_count += n_new
+            wave_new += survivors
+            self._unique_count += survivors
+            if survivors:
+                self._log_wave(wave, n_new, keep, k64)
                 # Lane width of the DISPATCHED chunk (the bucket), so the
                 # enqueue padding target scales with the bucket instead of
                 # re-inflating every sparse wave's output to F_max × A.
                 self._enqueue(
                     queue, wave, n_new, chunk["hi"].shape[0] * self._A,
-                    chunk,
+                    chunk, keep,
                 )
             if not int(stats[2]):
                 self._record_wave_metrics(
-                    span, chunk["hi"].shape[0], generated, wave_new
+                    span, chunk["hi"].shape[0], generated, wave_new,
+                    stale=stale_total,
                 )
                 return table, wave_new
+            if self._max_capacity is not None and attempt >= 8:
+                # Pathological probe-window cluster: the wave overflows
+                # even a freshly-evicted budget-capped table — a
+                # configuration error, not a loop to spin in (mirrors
+                # the sharded checker's guard).
+                raise RuntimeError(
+                    "a wave's candidates overflow the budget-capped "
+                    "device table after repeated evictions; raise "
+                    "hbm_budget_mib or shrink frontier_capacity"
+                )
             table = self._grow_table(table, self._capacity * 2)
             attempt += 1
             wave = None
 
-    def _record_wave_metrics(self, span, frontier, generated, n_new):
-        """One wave's telemetry (the shared bundle does the recording)."""
+    def _record_wave_metrics(self, span, frontier, generated, n_new,
+                             stale=None):
+        """One wave's telemetry (the shared bundle does the recording).
+        Occupancy is the TABLE's (L0-resident keys over capacity) — under
+        tiering the global unique count keeps growing past what the
+        device holds."""
         bucket, live = self._last_dispatch or (None, None)
+        extra = {}
+        if self._tier is not None:
+            self._tier.instruments.set_l0(self._l0_count)
+            extra["storage_stale"] = stale or 0
+            extra["storage_fps"] = self._tier.total_fps
         self._wi.record(
             span,
             frontier=frontier,
             generated=generated,
             n_new=n_new,
-            occupancy=self._unique_count / self._capacity,
+            occupancy=self._l0_count / self._capacity,
             capacity=self._capacity,
             max_depth=self._max_depth,
             phase="warmup" if self.warmup_seconds is None else "steady",
             bucket=bucket,
             compaction_ratio=(live / bucket if bucket else None),
+            **extra,
         )
 
     def _explore_waves(self, table, queue, depth_cap, t_start):
@@ -1473,9 +1629,9 @@ class TpuBfsChecker(Checker):
             chunks += 1
             chunk = queue.popleft()
             B = chunk["hi"].shape[0] * self._A
-            if (self._unique_count + B) > _MAX_LOAD * self._capacity:
+            if (self._l0_count + B) > _MAX_LOAD * self._capacity:
                 table = self._grow_table(
-                    table, _pow2ceil(int((self._unique_count + B) / _MAX_LOAD))
+                    table, _pow2ceil(int((self._l0_count + B) / _MAX_LOAD))
                 )
             with self._tracer.span(
                 "tpu_bfs.wave", wave=chunks
@@ -1516,6 +1672,11 @@ class TpuBfsChecker(Checker):
         while True:
             if len(self._discoveries_fp) == len(props):
                 break
+            # First L0 eviction ends deep-drain mode: from here every
+            # wave's fresh set needs the host-side L1/L2 probe, which a
+            # device-resident drain cannot perform mid-loop.
+            if self._tier is not None and not self._tier.is_empty():
+                return table, self._handoff_queue(pool, head, count, queue)
             # The host queue must FULLY drain into the ring before the next
             # drain: leftover spilled chunks are older than anything the
             # drain will push, so leaving them queued would let newer states
@@ -1554,10 +1715,16 @@ class TpuBfsChecker(Checker):
                 )
                 last_checkpoint = time.perf_counter()
             drains += 1
-            if (self._unique_count + B) > _MAX_LOAD * self._capacity:
+            if (self._l0_count + B) > _MAX_LOAD * self._capacity:
                 table = self._grow_table(
-                    table, _pow2ceil(int((self._unique_count + B) / _MAX_LOAD))
+                    table, _pow2ceil(int((self._l0_count + B) / _MAX_LOAD))
                 )
+                if self._tier is not None and not self._tier.is_empty():
+                    # The pregrow evicted (budget hit): the queue is empty
+                    # (flushed above), the ring holds the whole frontier.
+                    return table, self._handoff_queue(
+                        pool, head, count, queue
+                    )
             undiscovered = np.array(
                 [p.name not in self._discoveries_fp for p in props]
             )
@@ -1565,7 +1732,7 @@ class TpuBfsChecker(Checker):
             # must saturate, not overflow.
             budget = jnp.int32(
                 min(
-                    int(_MAX_LOAD * self._capacity) - self._unique_count,
+                    int(_MAX_LOAD * self._capacity) - self._l0_count,
                     (1 << 31) - 1 - B,
                 )
             )
@@ -1624,6 +1791,9 @@ class TpuBfsChecker(Checker):
                 log_n = int(dstats[0])
                 self._state_count += int(dstats[1])
                 self._unique_count += int(dstats[2])
+                # Drains only run while the tier is empty, so every drain
+                # fresh is also an L0 resident.
+                self._l0_count += int(dstats[2])
                 self._max_depth = max(self._max_depth, int(dstats[3]))
                 # A drain consumes many waves device-side; its span carries
                 # the aggregate (per-wave granularity would need per-wave
@@ -1653,7 +1823,7 @@ class TpuBfsChecker(Checker):
                     frontier=self._F_max,
                     generated=int(dstats[1]),
                     n_new=int(dstats[2]),
-                    occupancy=self._unique_count / self._capacity,
+                    occupancy=self._l0_count / self._capacity,
                     capacity=self._capacity,
                     max_depth=self._max_depth,
                     count_wave=False,
@@ -1685,6 +1855,24 @@ class TpuBfsChecker(Checker):
             # Exact pending live lanes: the ring's count plus the final
             # wave's fresh spill — the next drain's bucket selector input.
             live_est = pool_count + spilled
+
+    def _handoff_queue(self, pool, head, count, queue):
+        """Builds the wave-mode chunk queue for the permanent switch out
+        of deep-drain mode (first L0 eviction). The device ring's
+        contents are OLDER than any host-queue spill (the drain's final
+        wave spilled after everything it had consumed), so the ring
+        exports ahead of the queue — exact FIFO, hence exact BFS order,
+        is preserved and the run stays bit-identical. The caller
+        (_explore) resumes on the wave path only after _explore_deep's
+        frame unwinds, releasing the ring's device buffers."""
+        chunks = self._export_pool_chunks(pool, head, count)
+        newq = deque(chunks)
+        newq.extend(queue)
+        self._tracer.instant(
+            "tpu_bfs.storage.wave_mode", ring_chunks=len(chunks),
+            spilled_chunks=len(queue),
+        )
+        return newq
 
     def _drain_exe(self, width, args, t_start):
         """The AOT-compiled deep drain for one ladder rung, keyed on
@@ -1740,6 +1928,7 @@ class TpuBfsChecker(Checker):
         table = out["table"]
         self._state_count = int(out["n_valid"])
         self._unique_count = int(out["n_unique"])
+        self._l0_count = self._unique_count
         # Seed the cumulative counters too, so the registry's totals match
         # the checker's (init states never flow through a wave).
         self._wi.generated.inc(self._state_count)
@@ -1806,6 +1995,12 @@ class TpuBfsChecker(Checker):
                 if self._key_log
                 else np.zeros((0,), np.uint64)
             )
+        if self._tier is not None and not self._tier.is_empty():
+            # Out-of-core: the evicted runs + Bloom filters ride the
+            # checkpoint (CRC-validated on restore); the L0 set is
+            # rebuilt on restore as "known keys not in any run", which
+            # always fits the budget.
+            payload["storage"] = self._tier.export_state()
         atomic_pickle(path, payload)
 
     def _restore(self, path):
@@ -1837,22 +2032,46 @@ class TpuBfsChecker(Checker):
             keys = payload["keys"]
             self._key_log.append(keys)
 
-        # Rebuild the device visited set by claim-inserting all known keys.
+        # Out-of-core checkpoints carry the evicted runs; load them first
+        # (CRC-validated per run) so the L0 rebuild below inserts only
+        # the keys no run holds — that set always fits the HBM budget.
+        storage_state = payload.get("storage")
+        if storage_state:
+            if self._tier is None:
+                # Restored without budget knobs: hold the runs anyway
+                # (unbounded L0 from here on, probes stay correct).
+                from ..storage import TieredVisitedStore
+
+                self._tier = TieredVisitedStore(prefix="tpu_bfs")
+            self._tier.load_state(storage_state)
+        insert_keys = keys
+        if self._tier is not None and not self._tier.is_empty():
+            insert_keys = keys[~self._tier.probe(keys)]
+
+        # Rebuild the device visited set by claim-inserting the L0 keys.
         self._capacity = max(self._capacity, payload["capacity"])
+        if self._max_capacity is not None:
+            self._capacity = min(self._capacity, self._max_capacity)
         table = hashset_new(self._capacity)
-        hi = (keys >> np.uint64(32)).astype(np.uint32)
-        lo = (keys & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+        hi = (insert_keys >> np.uint64(32)).astype(np.uint32)
+        lo = (insert_keys & np.uint64(0xFFFFFFFF)).astype(np.uint32)
         batch = 1 << 16
-        for start in range(0, len(keys), batch):
+        if self._max_capacity is not None:
+            # A batch must fit a freshly-evicted table under the load cap
+            # or the grow-and-retry below could loop.
+            batch = min(batch, int(self._max_capacity * _MAX_LOAD))
+        for start in range(0, len(insert_keys), batch):
             bh = jnp.asarray(hi[start : start + batch])
             bl = jnp.asarray(lo[start : start + batch])
             active = jnp.ones((bh.shape[0],), bool)
-            table, _fresh, _found, pending = hashset_insert(
+            table, fresh, _found, pending = hashset_insert(
                 table, bh, bl, active
             )
+            self._l0_count += int(fresh.sum())
             if int(pending.sum()):
                 table = self._grow_table(table, self._capacity * 2)
-                table, _f, _fo, pend2 = hashset_insert(table, bh, bl, active)
+                table, f2, _fo, pend2 = hashset_insert(table, bh, bl, active)
+                self._l0_count += int(f2.sum())
                 if int(pend2.sum()):
                     raise RuntimeError("checkpoint restore overflowed table")
         queue = deque(
@@ -1861,19 +2080,40 @@ class TpuBfsChecker(Checker):
         )
         return table, queue
 
-    def _log_wave(self, wave, n_new):
-        self._wave_log.append(
-            (
-                fp64_pairs(wave["new"]["hi"][:n_new], wave["new"]["lo"][:n_new]),
-                fp64_pairs(wave["parent_hi"][:n_new], wave["parent_lo"][:n_new]),
+    def _log_wave(self, wave, n_new, keep=None, probe_keys=None):
+        """Logs the wave's fresh (child, parent[, key]) fps; ``keep``
+        (optional int32 positions into the fresh prefix) restricts to the
+        lanes that survived the L1/L2 host probe. ``probe_keys`` is the
+        u64 key array that probe already pulled for the same prefix
+        (== the child fps, or the orbit keys under symmetry) — reused so
+        the hot out-of-core path pays one device pull, not two."""
+        if probe_keys is not None and not self._symmetry_enabled:
+            child = probe_keys
+        else:
+            child = fp64_pairs(
+                wave["new"]["hi"][:n_new], wave["new"]["lo"][:n_new]
             )
+        parent = fp64_pairs(
+            wave["parent_hi"][:n_new], wave["parent_lo"][:n_new]
         )
+        if keep is not None:
+            child, parent = child[keep], parent[keep]
+        self._wave_log.append((child, parent))
         if self._symmetry_enabled:
-            self._key_log.append(
-                fp64_pairs(wave["key_hi"][:n_new], wave["key_lo"][:n_new])
+            keys = (
+                probe_keys
+                if probe_keys is not None
+                else fp64_pairs(
+                    wave["key_hi"][:n_new], wave["key_lo"][:n_new]
+                )
             )
+            if keep is not None:
+                keys = keys[keep]
+            self._key_log.append(keys)
 
-    def _enqueue(self, queue, wave, n_new, B, chunk):
+    def _enqueue(self, queue, wave, n_new, B, chunk, keep=None):
+        if keep is not None:
+            return self._enqueue_survivors(queue, wave, chunk, keep)
         target = -(-B // self._F_max) * self._F_max
         padded = self._jit_finish(dict(wave["new"]), jnp.int32(n_new), target)
         for start in range(0, n_new, self._F_max):
@@ -1883,6 +2123,32 @@ class TpuBfsChecker(Checker):
                 # action) references against the producing frontier —
                 # ceil(n_new / F_max) materializations per wave, never the
                 # full F × A grid.
+                piece = self._jit_materialize(chunk["states"], piece)
+            queue.append(piece)
+
+    def _enqueue_survivors(self, queue, wave, chunk, keep):
+        """Enqueue path for a host-probe-filtered wave: gathers the
+        surviving lanes (host index list into the fresh prefix) into
+        F_max-wide chunks. Relative lane order is preserved, so the
+        frontier sequence matches the unbounded run's exactly — the keys
+        dropped here are precisely the ones that run never saw fresh."""
+        new = wave["new"]
+        F = self._F_max
+        for start in range(0, len(keep), F):
+            sel = keep[start : start + F]
+            idx = np.zeros((F,), np.int32)
+            idx[: len(sel)] = sel
+            idx_j = jnp.asarray(idx)
+            piece = {
+                k: (
+                    jax.tree_util.tree_map(lambda x: x[idx_j], v)
+                    if k == "states"
+                    else v[idx_j]
+                )
+                for k, v in new.items()
+            }
+            piece["mask"] = jnp.arange(F, dtype=jnp.int32) < len(sel)
+            if self._use_fps:
                 piece = self._jit_materialize(chunk["states"], piece)
             queue.append(piece)
 

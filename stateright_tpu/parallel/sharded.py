@@ -134,6 +134,9 @@ class ShardedTpuBfsChecker(Checker):
         drain_log_factor=8,
         pool_factor=16,
         bucket_ladder=None,
+        hbm_budget_mib=None,
+        host_budget_mib=None,
+        spill_dir=None,
     ):
         model = options.model
         if not isinstance(model, BatchableModel):
@@ -208,6 +211,69 @@ class ShardedTpuBfsChecker(Checker):
         self._PCl = _pow2ceil(
             max(max(1, pool_factor) * self._F_loc, self._F_loc * self._A)
         )
+
+        # Out-of-core tiering (stateright_tpu.storage): ``hbm_budget_mib``
+        # hard-caps each shard's table; growth past the cap drains every
+        # shard to its own host tier (fps are mesh-partitioned by
+        # ``hi % n``, so runs stay shard-local), and harvested fresh rows
+        # batch-probe the tiers at the wave's host exit. Probes take the
+        # union over all stores (Bloom filters make non-owner probes O(1)
+        # rejects), which keeps elastic restores — where ownership
+        # re-routes — correct for free. ``host_budget_mib`` divides
+        # evenly across the shards' stores.
+        from ..storage import (
+            StorageInstruments,
+            TieredVisitedStore,
+            max_table_rows_for_budget,
+            validate_budget_knobs,
+        )
+
+        validate_budget_knobs(hbm_budget_mib, host_budget_mib, spill_dir)
+        self._tiers = []
+        self._si = None
+        self._max_cap_loc = None
+        if hbm_budget_mib is not None:
+            max_cap = max_table_rows_for_budget(hbm_budget_mib)
+            # A freshly-evicted shard must absorb one wave of received
+            # keys under the load cap. Keys are uniform over shards
+            # (fingerprints), so the floor is the balanced share
+            # (F_loc×A) with 4x skew slack — the true worst case (every
+            # key routing to one shard) is astronomically unlikely and
+            # is caught by the eviction-retry guard in the wave loop
+            # instead of pricing every budget for it.
+            worst = 4 * self._F_loc * self._A
+            min_cap = _pow2ceil(int(worst / _MAX_LOAD) + 1)
+            if max_cap < min_cap:
+                raise ValueError(
+                    f"hbm_budget_mib={hbm_budget_mib} allows a per-shard "
+                    f"table of {max_cap} rows, but one wave "
+                    f"({worst} routed keys at 4x skew) needs at least "
+                    f"{min_cap}; raise the budget or shrink "
+                    "frontier_per_device"
+                )
+            self._max_cap_loc = max_cap
+            self._cap_loc = min(self._cap_loc, max_cap)
+            self._si = StorageInstruments("sharded_bfs")
+            self._tiers = [
+                TieredVisitedStore(
+                    host_budget_mib=(
+                        host_budget_mib / n
+                        if host_budget_mib is not None
+                        else None
+                    ),
+                    spill_dir=spill_dir,
+                    instruments=self._si,
+                    shard=d,
+                )
+                for d in range(n)
+            ]
+            # Out-of-core needs the per-wave host probe, which only the
+            # wave-at-a-time path performs.
+            self._max_drain_waves = 1
+        # Keys currently resident across the shard tables (== unique_count
+        # until the first eviction).
+        self._l0_count = 0
+        self._wave_stale = 0
 
         self._state_count = 0
         self._unique_count = 0
@@ -830,12 +896,58 @@ class ShardedTpuBfsChecker(Checker):
         )()
 
     def _grow_table(self, table, min_cap_loc):
+        if (
+            self._max_cap_loc is not None
+            and min_cap_loc > self._max_cap_loc
+        ):
+            return self._evict_shards(table)
         while self._cap_loc < min_cap_loc:
             self._cap_loc *= 2
-        out = self._jit_rehash(table, self._new_table())
-        if int(self._pull(out["overflow"]).sum()):
-            raise RuntimeError("sharded rehash overflowed probe cap")
+        while True:
+            out = self._jit_rehash(table, self._new_table())
+            if not int(self._pull(out["overflow"]).sum()):
+                break
+            # Probe-cap overflow during rehash costs capacity (retry at
+            # the next doubling), never the run; under a budget the next
+            # doubling may not exist — evict instead.
+            self._cap_loc *= 2
+            if (
+                self._max_cap_loc is not None
+                and self._cap_loc > self._max_cap_loc
+            ):
+                return self._evict_shards(table)
         return out["table"]
+
+    def _tier_active(self) -> bool:
+        return any(not t.is_empty() for t in self._tiers)
+
+    def _evict_shards(self, table):
+        """Budget-capped growth: every shard's table drains to its own
+        host tier (keys stay mesh-partitioned) and the sharded set
+        resets at the budget cap."""
+        tab = self._pull(table)  # (n, cap_loc + apron, 2)
+        for d in range(self._n):
+            sh = tab[d]
+            live = (sh[:, 0] != 0) | (sh[:, 1] != 0)
+            keys = (
+                sh[live, 0].astype(np.uint64) << np.uint64(32)
+            ) | sh[live, 1].astype(np.uint64)
+            self._tiers[d].evict(keys)
+        self._cap_loc = self._max_cap_loc
+        self._l0_count = 0
+        self._si.set_l0(0)
+        return self._new_table()
+
+    def _probe_tiers(self, keys):
+        """Union membership over every shard's store (L1 then L2 inside
+        each; Bloom filters reject non-owner probes in O(1))."""
+        found = np.zeros(len(keys), bool)
+        for t in self._tiers:
+            rem = np.flatnonzero(~found)
+            if not len(rem):
+                break
+            found[rem] = t.probe(keys[rem])
+        return found
 
     def _pull(self, x):
         """A numpy view of a device array. Multi-controller: the array's
@@ -955,6 +1067,8 @@ class ShardedTpuBfsChecker(Checker):
             and self._visitor is None
             and self._target_state_count is None
             and self._depth_cap == _DEPTH_INF
+            # A resumed out-of-core run needs the per-wave host probe.
+            and not (self._tiers and self._tier_active())
         ):
             self._explore_deep(table, depth_cap)
         else:
@@ -987,11 +1101,11 @@ class ShardedTpuBfsChecker(Checker):
                 last_checkpoint = time.perf_counter()
             chunks += 1
             B_glob = G * A
-            if (self._unique_count + B_glob) > _MAX_LOAD * n * self._cap_loc:
+            if (self._l0_count + B_glob) > _MAX_LOAD * n * self._cap_loc:
                 table = self._grow_table(
                     table,
                     _pow2ceil(
-                        int((self._unique_count + B_glob) / (_MAX_LOAD * n))
+                        int((self._l0_count + B_glob) / (_MAX_LOAD * n))
                     ),
                 )
             # Occupancy-adaptive dispatch: the host pool count is exact
@@ -1018,6 +1132,7 @@ class ShardedTpuBfsChecker(Checker):
             attempt = 0
             wave_generated = 0
             wave_new = 0
+            self._wave_stale = 0
             with self._tracer.span(
                 "sharded_bfs.wave", wave=chunks
             ) as sp, device_step_annotation("sharded_bfs.wave", chunks):
@@ -1051,6 +1166,16 @@ class ShardedTpuBfsChecker(Checker):
                     wave_new += self._harvest(wave)
                     if not int(self._pull(wave["overflow"]).sum()):
                         break
+                    if self._max_cap_loc is not None and attempt >= 8:
+                        # Pathological key skew: one shard overflows even
+                        # freshly evicted — a configuration error, not a
+                        # loop to spin in.
+                        raise RuntimeError(
+                            "a single wave's routed keys overflow one "
+                            "budget-capped shard after repeated "
+                            "evictions; raise hbm_budget_mib or shrink "
+                            "frontier_per_device"
+                        )
                     table = self._grow_table(table, self._cap_loc * 2)
                     attempt += 1
                 self._record_wave_metrics(
@@ -1167,11 +1292,11 @@ class ShardedTpuBfsChecker(Checker):
                 last_checkpoint = time.perf_counter()
             drains += 1
             B_glob = G * A
-            if (self._unique_count + B_glob) > _MAX_LOAD * n * self._cap_loc:
+            if (self._l0_count + B_glob) > _MAX_LOAD * n * self._cap_loc:
                 table = self._grow_table(
                     table,
                     _pow2ceil(
-                        int((self._unique_count + B_glob) / (_MAX_LOAD * n))
+                        int((self._l0_count + B_glob) / (_MAX_LOAD * n))
                     ),
                 )
             undiscovered = np.array(
@@ -1181,7 +1306,7 @@ class ShardedTpuBfsChecker(Checker):
             # (> 2^31 slots across the mesh) must saturate, not overflow.
             budget = jnp.int32(
                 min(
-                    int(_MAX_LOAD * n * self._cap_loc) - self._unique_count,
+                    int(_MAX_LOAD * n * self._cap_loc) - self._l0_count,
                     (1 << 31) - 1 - G * A,
                 )
             )
@@ -1214,6 +1339,8 @@ class ShardedTpuBfsChecker(Checker):
                 drain_new = int(dstats[:, 2].sum())
                 self._state_count += drain_generated
                 self._unique_count += drain_new
+                # Drains only run tier-empty: every fresh is L0-resident.
+                self._l0_count += drain_new
                 self._max_depth = max(
                     self._max_depth, int(dstats[:, 3].max())
                 )
@@ -1227,7 +1354,7 @@ class ShardedTpuBfsChecker(Checker):
                     frontier=self._G,
                     generated=drain_generated,
                     n_new=drain_new,
-                    occupancy=self._unique_count / (self._n * self._cap_loc),
+                    occupancy=self._l0_count / (self._n * self._cap_loc),
                     capacity=self._n * self._cap_loc,
                     max_depth=self._max_depth,
                     count_wave=False,
@@ -1287,6 +1414,7 @@ class ShardedTpuBfsChecker(Checker):
         n_new = dstats[:, 6]
         total_new = int(n_new.sum())
         self._unique_count += total_new
+        self._l0_count += total_new
         self._wi.unique.inc(total_new)
         self._wi.generated.inc(int(dstats[:, 7].sum()))
         self._wi.wave_new.observe(total_new)
@@ -1399,6 +1527,7 @@ class ShardedTpuBfsChecker(Checker):
         fresh = self._pull(out["fresh"])
         self._state_count = int(valid.sum())
         self._unique_count = int(fresh.sum())
+        self._l0_count = self._unique_count
         # Seed the cumulative counters too (init states skip the waves).
         self._wi.generated.inc(self._state_count)
         self._wi.unique.inc(self._unique_count)
@@ -1460,6 +1589,11 @@ class ShardedTpuBfsChecker(Checker):
                 if self._key_log
                 else np.zeros((0,), np.uint64)
             )
+        if self._tiers and self._tier_active():
+            # Out-of-core: every shard's runs + Bloom filters ride the
+            # checkpoint (CRC-validated on restore); the shard tables
+            # rebuild as "known keys not in any run".
+            payload["storage"] = [t.export_state() for t in self._tiers]
         # Multi-controller: every process builds the identical payload;
         # exactly one writes the file.
         if jax.process_index() == 0:
@@ -1495,24 +1629,68 @@ class ShardedTpuBfsChecker(Checker):
         for batch in payload["pool"]:
             self._pool_append(batch)
 
-        # Rebuild the sharded visited set by claim-inserting all known keys
-        # through the normal routed insert — each key lands on its owner
-        # shard under the *current* mesh, so shard count may differ from
-        # the writer's.
+        # Out-of-core checkpoints carry per-shard run lists. Same mesh
+        # width: load each store as written. Different width (elastic
+        # restore): re-partition the runs' keys by owner under the
+        # CURRENT mesh so per-shard host budgets stay balanced — probe
+        # correctness never depended on the partitioning (union probe).
         n = self._n
+        storage_state = payload.get("storage")
+        if storage_state:
+            if not self._tiers:
+                # Restored without budget knobs: hold the runs anyway
+                # (unbounded shard tables from here on, probes correct).
+                from ..storage import StorageInstruments, TieredVisitedStore
+
+                self._si = StorageInstruments("sharded_bfs")
+                self._tiers = [
+                    TieredVisitedStore(instruments=self._si, shard=d)
+                    for d in range(n)
+                ]
+            if len(storage_state) == n:
+                for t, s in zip(self._tiers, storage_state):
+                    t.load_state(s)
+            else:
+                from ..storage.runs import FingerprintRun
+
+                allk = [
+                    FingerprintRun.from_state(r).decode_all()
+                    for s in storage_state
+                    for r in list(s.get("l1", [])) + list(s.get("l2", []))
+                ]
+                allk = np.unique(np.concatenate(allk))
+                owner = ((allk >> np.uint64(32)) % np.uint64(n)).astype(
+                    np.int64
+                )
+                for d in range(n):
+                    self._tiers[d].evict(allk[owner == d])
+
+        # Rebuild the sharded visited set by claim-inserting the L0 keys
+        # (all known keys minus the tiers' runs) through the normal
+        # routed insert — each key lands on its owner shard under the
+        # *current* mesh, so shard count may differ from the writer's.
         if payload["n_shards"] == n:
             # Same mesh width: start at the writer's shard capacity so the
             # rebuild needs no growth rounds.
             self._cap_loc = max(self._cap_loc, payload["cap_loc"])
+        insert_keys = keys
+        if self._tiers and self._tier_active():
+            insert_keys = keys[~self._probe_tiers(keys)]
         need = _pow2ceil(
-            max(int(len(keys) / (_MAX_LOAD * n)), self._cap_loc)
+            max(int(len(insert_keys) / (_MAX_LOAD * n)), self._cap_loc)
         )
         self._cap_loc = need
+        if self._max_cap_loc is not None:
+            self._cap_loc = min(self._cap_loc, self._max_cap_loc)
         table = self._new_table()
-        hi = (keys >> np.uint64(32)).astype(np.uint32)
-        lo = (keys & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+        hi = (insert_keys >> np.uint64(32)).astype(np.uint32)
+        lo = (insert_keys & np.uint64(0xFFFFFFFF)).astype(np.uint32)
         W = n * (1 << 13)
-        for start in range(0, len(keys), W):
+        if self._max_cap_loc is not None:
+            # A batch must fit one freshly-evicted shard under the load
+            # cap even if every key routes there.
+            W = min(W, n * max(1, int(self._max_cap_loc * _MAX_LOAD) // n))
+        for start in range(0, len(insert_keys), W):
             bh = hi[start : start + W]
             bl = lo[start : start + W]
             m = len(bh)
@@ -1529,6 +1707,7 @@ class ShardedTpuBfsChecker(Checker):
                     ),
                 )
                 table = out["table"]
+                self._l0_count += int(self._pull(out["fresh"]).sum())
                 if not int(self._pull(out["overflow"]).sum()):
                     break
                 table = self._grow_table(table, self._cap_loc * 2)
@@ -1536,10 +1715,14 @@ class ShardedTpuBfsChecker(Checker):
 
     def _harvest(self, wave):
         """Pulls each device's compacted fresh rows into the host pool;
-        returns the global fresh count (telemetry)."""
+        returns the global fresh count surviving the tier probe
+        (telemetry). Out-of-core mode filters here: L0-fresh rows whose
+        key lives in an evicted run are stale — never re-counted,
+        re-logged, or re-pooled — so the run stays bit-identical to the
+        unbounded one."""
         n_new = self._pull(wave["n_new"])
         total = int(n_new.sum())
-        self._unique_count += total
+        self._l0_count += total
         if not total:
             return total
         hi = self._pull(wave["new_hi"])
@@ -1557,41 +1740,59 @@ class ShardedTpuBfsChecker(Checker):
             sel[d * B : d * B + int(n_new[d])] = True
         child64 = fp64_pairs(hi, lo)
         par64 = fp64_pairs(phi, plo)
-        self._wave_log.append((child64[sel], par64[sel]))
+        key64 = None
         if self._symmetry_enabled:
-            self._key_log.append(
-                fp64_pairs(
-                    self._pull(wave["new_khi"]), self._pull(wave["new_klo"])
-                )[sel]
+            key64 = fp64_pairs(
+                self._pull(wave["new_khi"]), self._pull(wave["new_klo"])
             )
+        idx = np.flatnonzero(sel)
+        if self._tiers and self._tier_active():
+            keys = (key64 if key64 is not None else child64)[idx]
+            stale = self._probe_tiers(keys)
+            self._wave_stale += int(stale.sum())
+            idx = idx[~stale]
+        survivors = len(idx)
+        self._unique_count += survivors
+        if not survivors:
+            return 0
+        self._wave_log.append((child64[idx], par64[idx]))
+        if self._symmetry_enabled:
+            self._key_log.append(key64[idx])
         self._pool_append(
             {
-                "states": jax.tree_util.tree_map(lambda x: x[sel], states),
-                "hi": hi[sel],
-                "lo": lo[sel],
-                "ebits": ebits[sel].astype(np.uint32),
-                "depth": depth[sel].astype(np.int32),
+                "states": jax.tree_util.tree_map(lambda x: x[idx], states),
+                "hi": hi[idx],
+                "lo": lo[idx],
+                "ebits": ebits[idx].astype(np.uint32),
+                "depth": depth[idx].astype(np.int32),
             }
         )
-        return total
+        return survivors
 
     def _record_wave_metrics(
         self, span, frontier, generated, n_new, bucket=None,
         compaction_ratio=None,
     ):
         """One host-visible wave's telemetry (the shared bundle does the
-        recording; occupancy is global across the mesh's shards)."""
+        recording; occupancy is the shard tables' resident load — under
+        tiering the global unique count outgrows the devices)."""
+        extra = {}
+        if self._si is not None:
+            self._si.set_l0(self._l0_count)
+            extra["storage_stale"] = self._wave_stale
+            extra["storage_fps"] = sum(t.total_fps for t in self._tiers)
         self._wi.record(
             span,
             frontier=frontier,
             generated=generated,
             n_new=n_new,
-            occupancy=self._unique_count / (self._n * self._cap_loc),
+            occupancy=self._l0_count / (self._n * self._cap_loc),
             capacity=self._n * self._cap_loc,
             max_depth=self._max_depth,
             phase="warmup" if self.warmup_seconds is None else "steady",
             bucket=bucket,
             compaction_ratio=compaction_ratio,
+            **extra,
         )
 
     def _visit_chunk(self, chunk):

@@ -1,0 +1,48 @@
+"""Out-of-core tiered visited-fingerprint store.
+
+The device checkers' visited set historically grew by doubling + rehash
+(`ops/hashset.py`) until HBM ran out, hard-capping the largest checkable
+state space at device memory. This package removes that ceiling with a
+three-tier layout behind a batched probe/evict API:
+
+- **L0** — the existing device hash table, now governed by a hard
+  ``hbm_budget_mib`` knob on the checkers: when growth would exceed the
+  budget, the full table drains to the host and resets, keeping only the
+  working set (hot recent generations) on device.
+- **L1** — evicted fingerprints as host-resident, delta-compressed sorted
+  runs (64-bit fps sorted ascending, varint deltas, block-indexed for
+  binary search) fronted by a per-run Bloom filter sized for <1% false
+  positives. Runs merge LSM-style when their count passes a threshold.
+- **L2** — merged runs spill to disk files when host bytes pass
+  ``host_budget_mib``, with the same run/filter format so probes are
+  uniform (the payload is just read block-wise from the file).
+
+Wave dedup becomes a two-phase probe: the device table filters first,
+then surviving L0-fresh candidates batch-probe L1/L2 on the host during
+the wave's host exit. Results are bit-identical to the single-tier path:
+the union of the tiers is exactly the visited set, so a key reports fresh
+iff it was never seen (``tests/test_storage_equivalence.py``).
+
+See README "Memory hierarchy" for the knobs and when eviction pays.
+"""
+
+from .bloom import BloomFilter
+from .runs import RUN_BLOCK, FingerprintRun, decode_varint_u64, encode_varint_u64
+from .tiered import (
+    StorageInstruments,
+    TieredVisitedStore,
+    max_table_rows_for_budget,
+    validate_budget_knobs,
+)
+
+__all__ = [
+    "BloomFilter",
+    "FingerprintRun",
+    "RUN_BLOCK",
+    "StorageInstruments",
+    "TieredVisitedStore",
+    "decode_varint_u64",
+    "encode_varint_u64",
+    "max_table_rows_for_budget",
+    "validate_budget_knobs",
+]

@@ -1,0 +1,324 @@
+"""Delta-compressed sorted fingerprint runs (the L1/L2 on-host format).
+
+A run is an immutable sorted array of distinct u64 fingerprints stored as
+varint-encoded consecutive deltas, chopped into ``RUN_BLOCK``-key blocks:
+
+- ``block_firsts[b]`` — the first fingerprint of block ``b``, absolute
+  (the binary-search directory: ``searchsorted`` picks the one candidate
+  block per probe key);
+- ``block_offsets[b] : block_offsets[b+1]`` — the byte range of block
+  ``b``'s payload, which encodes the block's REMAINING keys as varint
+  deltas from the previous key (blocks decode independently);
+- a per-run Bloom filter (``bloom.BloomFilter``, <1% FP) prefilters
+  probes so runs that cannot contain a key cost O(k) bit reads, and
+- a CRC32 over the payload + structural invariants, checked when a
+  checkpoint restores the run (round-trip validation).
+
+The payload lives in host memory (L1) or in a file under the spill
+directory (L2) — probes are uniform, only ``_payload_slice`` differs.
+Sorted-delta + varint typically lands ~2-3x under raw 8 B/key on dense
+fingerprint populations; ``compression_ratio`` reports the real figure.
+
+Encode/decode are fully vectorized numpy (no per-key Python loops): the
+varint byte stream is built/parsed with at most 10 masked passes (the max
+byte length of a u64 varint), which batches whole blocks per pass.
+"""
+
+from __future__ import annotations
+
+import os
+import zlib
+from typing import Optional
+
+import numpy as np
+
+__all__ = [
+    "RUN_BLOCK",
+    "FingerprintRun",
+    "encode_varint_u64",
+    "decode_varint_u64",
+]
+
+# Keys per block: 4096 keys ≈ a few KiB compressed — one block decode per
+# probe hit candidate, small enough that a miss costs microseconds.
+RUN_BLOCK = 4096
+
+
+def _varint_sizes(vals: np.ndarray) -> np.ndarray:
+    sizes = np.ones(len(vals), np.int64)
+    for shift in range(7, 64, 7):
+        sizes += vals >= (np.uint64(1) << np.uint64(shift))
+    return sizes
+
+
+def encode_varint_u64(vals: np.ndarray) -> bytes:
+    """LEB128 encoding of a u64 array, vectorized over masked byte passes."""
+    vals = np.asarray(vals, np.uint64)
+    if len(vals) == 0:
+        return b""
+    sizes = _varint_sizes(vals)
+    ends = np.cumsum(sizes)
+    starts = ends - sizes
+    out = np.zeros(int(ends[-1]), np.uint8)
+    for i in range(int(sizes.max())):
+        sel = sizes > i
+        byte = (
+            (vals[sel] >> np.uint64(7 * i)) & np.uint64(0x7F)
+        ).astype(np.uint8)
+        cont = (sizes[sel] - 1 > i).astype(np.uint8)
+        out[starts[sel] + i] = byte | (cont << 7)
+    return out.tobytes()
+
+
+def decode_varint_u64(buf: bytes) -> np.ndarray:
+    """Inverse of ``encode_varint_u64`` (terminator bytes have the MSB
+    clear, so the value boundaries fall out of one flatnonzero)."""
+    data = np.frombuffer(buf, np.uint8)
+    if len(data) == 0:
+        return np.zeros(0, np.uint64)
+    ends = np.flatnonzero(data < 128)
+    starts = np.empty(len(ends), np.int64)
+    starts[0] = 0
+    starts[1:] = ends[:-1] + 1
+    sizes = ends - starts + 1
+    vals = np.zeros(len(starts), np.uint64)
+    for i in range(int(sizes.max())):
+        sel = sizes > i
+        vals[sel] |= (
+            data[starts[sel] + i] & np.uint8(0x7F)
+        ).astype(np.uint64) << np.uint64(7 * i)
+    return vals
+
+
+class FingerprintRun:
+    """One immutable sorted run. Build with :meth:`build`; move to disk
+    with :meth:`spill`; serialize with :meth:`to_state`."""
+
+    def __init__(
+        self,
+        count: int,
+        block_firsts: np.ndarray,
+        block_offsets: np.ndarray,
+        bloom,
+        crc: int,
+        payload: Optional[bytes] = None,
+        path: Optional[str] = None,
+    ):
+        assert (payload is None) != (path is None)
+        self.count = int(count)
+        self.block_firsts = np.asarray(block_firsts, np.uint64)
+        self.block_offsets = np.asarray(block_offsets, np.int64)
+        self.bloom = bloom
+        self.crc = int(crc)
+        self.payload = payload
+        self.path = path
+        self.payload_nbytes = int(self.block_offsets[-1])
+        self.max_fp = None  # set by build/from_state
+        self._fh = None  # lazily-opened spill file (hot probe path)
+
+    # -- construction ------------------------------------------------------
+
+    @classmethod
+    def build(cls, fps: np.ndarray) -> "FingerprintRun":
+        """A run from sorted, strictly-increasing, non-empty u64 keys."""
+        from .bloom import BloomFilter
+
+        fps = np.asarray(fps, np.uint64)
+        n = len(fps)
+        assert n > 0, "runs are never empty"
+        firsts = fps[::RUN_BLOCK].copy()
+        chunks = []
+        offsets = np.zeros(len(firsts) + 1, np.int64)
+        for b in range(len(firsts)):
+            block = fps[b * RUN_BLOCK : (b + 1) * RUN_BLOCK]
+            chunks.append(encode_varint_u64(np.diff(block)))
+            offsets[b + 1] = offsets[b] + len(chunks[-1])
+        payload = b"".join(chunks)
+        run = cls(
+            count=n,
+            block_firsts=firsts,
+            block_offsets=offsets,
+            bloom=BloomFilter.build(fps),
+            crc=zlib.crc32(payload),
+            payload=payload,
+        )
+        run.max_fp = np.uint64(fps[-1])
+        return run
+
+    # -- payload access (uniform across host bytes and spill files) -------
+
+    def _payload_slice(self, lo: int, hi: int) -> bytes:
+        if self.payload is not None:
+            return self.payload[lo:hi]
+        # One handle per spilled run, opened lazily and kept: the probe
+        # path decodes a block per candidate per wave, and an
+        # open/seek/close trio per decode would dominate small reads.
+        if self._fh is None:
+            self._fh = open(self.path, "rb")
+        self._fh.seek(lo)
+        return self._fh.read(hi - lo)
+
+    def _payload_bytes(self) -> bytes:
+        if self.payload is not None:
+            return self.payload
+        return self._payload_slice(0, self.payload_nbytes)
+
+    def _block_len(self, b: int) -> int:
+        return min(RUN_BLOCK, self.count - b * RUN_BLOCK)
+
+    def decode_block(self, b: int) -> np.ndarray:
+        deltas = decode_varint_u64(
+            self._payload_slice(
+                int(self.block_offsets[b]), int(self.block_offsets[b + 1])
+            )
+        )
+        out = np.empty(len(deltas) + 1, np.uint64)
+        out[0] = self.block_firsts[b]
+        out[1:] = self.block_firsts[b] + np.cumsum(deltas, dtype=np.uint64)
+        return out
+
+    def decode_all(self) -> np.ndarray:
+        """The full sorted key array (merge path)."""
+        if self.count == 0:
+            return np.zeros(0, np.uint64)
+        return np.concatenate(
+            [self.decode_block(b) for b in range(len(self.block_firsts))]
+        )
+
+    # -- probe -------------------------------------------------------------
+
+    def probe(self, fps: np.ndarray, stats: Optional[dict] = None) -> np.ndarray:
+        """Membership mask for a u64 key batch: Bloom prefilter, then one
+        block decode + binary search per surviving candidate's block."""
+        fps = np.asarray(fps, np.uint64)
+        found = np.zeros(len(fps), bool)
+        if len(fps) == 0 or self.count == 0:
+            return found
+        cand = self.bloom.contains(fps)
+        if self.max_fp is not None:
+            cand &= fps <= self.max_fp
+        cand &= fps >= self.block_firsts[0]
+        if stats is not None:
+            stats["bloom_rejects"] = stats.get("bloom_rejects", 0) + int(
+                len(fps) - cand.sum()
+            )
+        if not cand.any():
+            return found
+        idx = np.flatnonzero(cand)
+        qs = fps[idx]
+        blk = np.searchsorted(self.block_firsts, qs, side="right") - 1
+        hits = np.zeros(len(qs), bool)
+        for b in np.unique(blk):
+            sel = blk == b
+            arr = self.decode_block(int(b))
+            pos = np.searchsorted(arr, qs[sel])
+            pos = np.minimum(pos, len(arr) - 1)
+            hits[sel] = arr[pos] == qs[sel]
+            if stats is not None:
+                stats["blocks_decoded"] = stats.get("blocks_decoded", 0) + 1
+        found[idx] = hits
+        return found
+
+    # -- spill / serialization --------------------------------------------
+
+    def close(self) -> None:
+        """Closes the spill-file handle (L2 compaction retires runs; a
+        long run must not accumulate one fd per retired file)."""
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+    def spill(self, path: str) -> "FingerprintRun":
+        """Writes the payload to ``path`` (atomic tmp+rename) and returns
+        the disk-backed twin; index + bloom stay in host memory."""
+        data = self._payload_bytes()
+        tmp = f"{path}.tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)
+        run = FingerprintRun(
+            count=self.count,
+            block_firsts=self.block_firsts,
+            block_offsets=self.block_offsets,
+            bloom=self.bloom,
+            crc=self.crc,
+            path=path,
+        )
+        run.max_fp = self.max_fp
+        return run
+
+    @property
+    def host_nbytes(self) -> int:
+        """Host-memory footprint: payload (when resident) + index + bloom."""
+        index = self.block_firsts.nbytes + self.block_offsets.nbytes
+        payload = len(self.payload) if self.payload is not None else 0
+        return payload + index + self.bloom.nbytes
+
+    @property
+    def disk_nbytes(self) -> int:
+        return self.payload_nbytes if self.path is not None else 0
+
+    def to_state(self) -> dict:
+        """Checkpoint form: payload embedded (checkpoints must be
+        self-contained — a spill file may not survive the machine the
+        checkpoint migrates to)."""
+        return {
+            "count": self.count,
+            "block_firsts": self.block_firsts,
+            "block_offsets": self.block_offsets,
+            "payload": self._payload_bytes(),
+            "bloom": self.bloom.to_state(),
+            "crc": self.crc,
+            "max_fp": None if self.max_fp is None else int(self.max_fp),
+        }
+
+    @classmethod
+    def from_state(cls, state: dict) -> "FingerprintRun":
+        """Round-trip validation: the payload CRC and the block structure
+        must match what the writer recorded, or the restore is refused —
+        a torn checkpoint must never silently drop visited states (which
+        would re-expand them and corrupt counts)."""
+        from .bloom import BloomFilter
+
+        payload = state["payload"]
+        if zlib.crc32(payload) != state["crc"]:
+            raise ValueError(
+                "fingerprint-run payload CRC mismatch: the checkpoint's "
+                "storage tier is corrupt; refusing to resume from it"
+            )
+        firsts = np.asarray(state["block_firsts"], np.uint64)
+        offsets = np.asarray(state["block_offsets"], np.int64)
+        count = int(state["count"])
+        if (
+            len(offsets) != len(firsts) + 1
+            or int(offsets[-1]) != len(payload)
+            or len(firsts) != -(-count // RUN_BLOCK)
+        ):
+            raise ValueError(
+                "fingerprint-run block structure does not match its "
+                "payload; refusing to resume from a corrupt checkpoint"
+            )
+        run = cls(
+            count=count,
+            block_firsts=firsts,
+            block_offsets=offsets,
+            bloom=BloomFilter.from_state(state["bloom"]),
+            crc=int(state["crc"]),
+            payload=payload,
+        )
+        run.max_fp = (
+            None if state.get("max_fp") is None else np.uint64(state["max_fp"])
+        )
+        # The CRC pins the payload but not the header fields; decode the
+        # last block (cheap) and check it against the recorded count and
+        # max key so a tampered/torn header cannot shift probe results.
+        last = run.decode_block(len(firsts) - 1)
+        want_len = run._block_len(len(firsts) - 1)
+        if len(last) != want_len or (
+            run.max_fp is not None and last[-1] != run.max_fp
+        ):
+            raise ValueError(
+                "fingerprint-run header does not match its payload; "
+                "refusing to resume from a corrupt checkpoint"
+            )
+        return run

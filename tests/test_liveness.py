@@ -279,3 +279,91 @@ def test_absence_certification_at_100k_states():
     assert find_eventually_lasso(g, g.prop) is None
     dt = time.time() - t0
     assert dt < 60, f"absence certification took {dt:.1f}s for {n} states"
+
+
+class _Diamond(Model, BatchableModel):
+    """0 -> {1, 2} -> 4 (terminal): the DAG-join repro on the DEVICE
+    path. BFS reaches terminal 4 first via odd 1 (ebit cleared, both
+    in-wave dedup pipelines deterministically keep the lower lane =
+    parent 1), so the join masks the genuine maximal counterexample
+    0 -> 2 -> 4 — the reference's FIXME #1 semantics, which the device
+    checkers reproduce bit-for-bit (checker/tpu.py parity notes)."""
+
+    _A0 = {0: 1, 1: 4, 2: 4}  # action 0; 4 is terminal
+    _A1 = {0: 2}  # action 1
+
+    def init_states(self):
+        return [0]
+
+    def actions(self, state, actions):
+        if state in self._A0:
+            actions.append("a0")
+        if state in self._A1:
+            actions.append("a1")
+
+    def next_state(self, state, action):
+        table = self._A0 if action == "a0" else self._A1
+        return table.get(state)
+
+    def properties(self):
+        return [Property.eventually("odd", lambda _, s: s % 2 == 1)]
+
+    # -- packed protocol ---------------------------------------------------
+
+    def packed_action_count(self):
+        return 2
+
+    def packed_init_states(self):
+        return {"s": jnp.zeros((1,), jnp.uint32)}
+
+    def packed_step(self, state, action_id):
+        s = state["s"]
+        nxt0 = jnp.where(
+            s == 0, jnp.uint32(1), jnp.uint32(4)
+        )  # 1 and 2 both step to 4
+        valid0 = (s == 0) | (s == 1) | (s == 2)
+        nxt = jnp.where(action_id == 0, nxt0, jnp.uint32(2))
+        valid = jnp.where(action_id == 0, valid0, s == 0)
+        return {"s": jnp.where(valid, nxt, s)}, valid
+
+    def packed_conditions(self):
+        return [lambda st: (st["s"] % 2) == 1]
+
+    def pack_state(self, host_state):
+        import numpy as np
+
+        return {"s": np.uint32(host_state)}
+
+    def unpack_state(self, packed):
+        return int(packed["s"])
+
+
+def test_terminal_merge_at_dag_join_pinned_on_device_checker():
+    # Regression pin for the liveness FIXME inheritance (the module
+    # docstring links here): the DEFAULT device checker must KEEP the
+    # reference's false negative — terminal 4's unmet-ebit is masked by
+    # the DAG join because the in-wave dedup winner (parent 1, the odd
+    # state) carries a cleared bit — while the opt-in pass finds the
+    # all-even maximal path. If the pin ever breaks, default semantics
+    # silently diverged from the reference.
+    plain = (
+        _Diamond()
+        .checker()
+        .spawn_tpu_bfs(frontier_capacity=8, table_capacity=1 << 9)
+        .join()
+    )
+    assert plain.worker_error() is None
+    assert plain.unique_state_count() == 4  # {0, 1, 2, 4}
+    assert plain.discoveries() == {}  # the known-wrong merge, pinned
+
+    fixed = (
+        _Diamond()
+        .checker()
+        .complete_liveness()
+        .spawn_tpu_bfs(frontier_capacity=8, table_capacity=1 << 9)
+        .join()
+    )
+    assert fixed.worker_error() is None
+    path = fixed.discoveries().get("odd")
+    assert path is not None
+    assert path.into_states() == [0, 2, 4]

@@ -1,0 +1,248 @@
+"""Out-of-core tiered visited store (stateright_tpu.storage): unit tests
+for the run/Bloom/store primitives, knob validation on the checkers, and
+the tier-1 eviction smoke (an L0→L1 eviction on CPU, steady-state under
+a second)."""
+
+import math
+import pickle
+import time
+
+import numpy as np
+import pytest
+
+from stateright_tpu.models.two_phase_commit import TwoPhaseSys
+from stateright_tpu.storage import (
+    RUN_BLOCK,
+    BloomFilter,
+    FingerprintRun,
+    TieredVisitedStore,
+    decode_varint_u64,
+    encode_varint_u64,
+)
+from stateright_tpu.telemetry import metrics_registry
+
+
+def budget_for_table(rows: int) -> float:
+    """The smallest hbm_budget_mib that admits a ``rows``-row table (plus
+    the probe apron the allocation carries)."""
+    return ((rows + 128) * 8) / (1 << 20)
+
+
+def min_table_rows(frontier: int, actions: int, load=0.55) -> int:
+    """The checker's own floor: one worst-case wave under the load cap."""
+    return 1 << math.ceil(math.log2(frontier * actions / load + 1))
+
+
+# -- varint codec ----------------------------------------------------------
+
+
+def test_varint_roundtrip_random():
+    rng = np.random.default_rng(7)
+    vals = rng.integers(0, 1 << 63, 50_000, dtype=np.uint64)
+    assert np.array_equal(decode_varint_u64(encode_varint_u64(vals)), vals)
+
+
+def test_varint_roundtrip_edges():
+    vals = np.array(
+        [0, 1, 127, 128, (1 << 35) - 1, 1 << 35, (1 << 64) - 1],
+        dtype=np.uint64,
+    )
+    assert np.array_equal(decode_varint_u64(encode_varint_u64(vals)), vals)
+    assert encode_varint_u64(np.zeros(0, np.uint64)) == b""
+    assert len(decode_varint_u64(b"")) == 0
+
+
+# -- bloom filter ----------------------------------------------------------
+
+
+def test_bloom_no_false_negatives_and_low_fp_rate():
+    rng = np.random.default_rng(11)
+    keys = np.unique(rng.integers(1, 1 << 62, 40_000, dtype=np.uint64))
+    bf = BloomFilter.build(keys)
+    assert bf.contains(keys).all()  # never a false negative
+    probes = rng.integers(1, 1 << 62, 100_000, dtype=np.uint64)
+    probes = probes[~np.isin(probes, keys)]
+    assert bf.contains(probes).mean() < 0.02  # sized for <1% FP
+
+
+# -- fingerprint runs ------------------------------------------------------
+
+
+def test_run_probe_exact_and_block_boundaries():
+    rng = np.random.default_rng(3)
+    # Straddle block boundaries exactly (RUN_BLOCK and RUN_BLOCK + 1).
+    for n in (5, RUN_BLOCK, RUN_BLOCK + 1, 3 * RUN_BLOCK + 17):
+        keys = np.unique(rng.integers(1, 1 << 62, n, dtype=np.uint64))
+        run = FingerprintRun.build(keys)
+        assert np.array_equal(run.decode_all(), keys)
+        q = np.concatenate(
+            [keys[::3], rng.integers(1, 1 << 62, 999, dtype=np.uint64)]
+        )
+        assert np.array_equal(run.probe(q), np.isin(q, keys))
+
+
+def test_run_checkpoint_roundtrip_and_corruption_rejected():
+    rng = np.random.default_rng(5)
+    keys = np.unique(rng.integers(1, 1 << 62, 9_000, dtype=np.uint64))
+    run = FingerprintRun.build(keys)
+    state = pickle.loads(pickle.dumps(run.to_state()))
+    back = FingerprintRun.from_state(state)
+    assert np.array_equal(back.decode_all(), keys)
+
+    corrupt = dict(state)
+    corrupt["payload"] = state["payload"][:-1] + b"\x00"
+    with pytest.raises(ValueError, match="CRC"):
+        FingerprintRun.from_state(corrupt)
+    torn = dict(state)
+    torn["count"] = state["count"] + 1
+    with pytest.raises(ValueError, match="does not match its payload"):
+        FingerprintRun.from_state(torn)
+    torn["count"] = state["count"] + RUN_BLOCK  # changes the block count
+    with pytest.raises(ValueError, match="block structure"):
+        FingerprintRun.from_state(torn)
+
+
+def test_run_spill_probe_uniform(tmp_path):
+    rng = np.random.default_rng(9)
+    keys = np.unique(rng.integers(1, 1 << 62, 12_000, dtype=np.uint64))
+    run = FingerprintRun.build(keys)
+    spilled = run.spill(str(tmp_path / "r.fpr"))
+    q = np.concatenate(
+        [keys[::5], rng.integers(1, 1 << 62, 2_000, dtype=np.uint64)]
+    )
+    assert np.array_equal(spilled.probe(q), run.probe(q))
+    assert spilled.disk_nbytes > 0 and spilled.payload is None
+
+
+# -- tiered store ----------------------------------------------------------
+
+
+def test_store_merges_at_threshold_and_dedups_cross_run_twins():
+    store = TieredVisitedStore(merge_run_threshold=3, prefix="t_merge")
+    rng = np.random.default_rng(13)
+    batch = rng.integers(1, 1 << 62, 5_000, dtype=np.uint64)
+    store.evict(batch)
+    store.evict(batch[: 2_000])  # duplicates of run 1
+    assert len(store.l1) == 2
+    store.evict(rng.integers(1, 1 << 62, 1_000, dtype=np.uint64))
+    # Threshold hit: one merged run, cross-run twins deduped.
+    assert len(store.l1) == 1
+    assert store.l1[0].count < 5_000 + 2_000 + 1_000
+    assert store.probe(np.unique(batch)).all()
+
+
+def test_store_spills_past_host_budget_and_probes_union(tmp_path):
+    store = TieredVisitedStore(
+        host_budget_mib=0.02, spill_dir=str(tmp_path), prefix="t_spill"
+    )
+    rng = np.random.default_rng(17)
+    batches = [
+        rng.integers(1, 1 << 62, 6_000, dtype=np.uint64) for _ in range(4)
+    ]
+    for b in batches:
+        store.evict(b)
+    assert store.l2, "host budget never spilled"
+    allk = np.unique(np.concatenate(batches))
+    assert store.probe(allk).all()
+    miss = rng.integers(1, 1 << 62, 3_000, dtype=np.uint64)
+    miss = miss[~np.isin(miss, allk)]
+    assert not store.probe(miss).any()
+    # Checkpoint round trip across spilled runs.
+    state = pickle.loads(pickle.dumps(store.export_state()))
+    back = TieredVisitedStore(prefix="t_spill_back")
+    back.load_state(state)
+    assert back.probe(allk).all()
+    assert not back.probe(miss).any()
+
+
+def test_store_compacts_l2_at_threshold(tmp_path):
+    """L2 spill files merge once the threshold accumulates: a long
+    tight-budget run must not grow fds and per-probe Bloom checks
+    linearly with its eviction count."""
+    store = TieredVisitedStore(
+        host_budget_mib=0.001, spill_dir=str(tmp_path),
+        merge_run_threshold=3, prefix="t_l2c",
+    )
+    rng = np.random.default_rng(23)
+    batches = [
+        rng.integers(1, 1 << 62, 4_000, dtype=np.uint64) for _ in range(7)
+    ]
+    for b in batches:
+        store.evict(b)  # budget ~1KiB: every run spills immediately
+    assert len(store.l2) < 3, f"L2 never compacted: {len(store.l2)} runs"
+    # Retired spill files are deleted, survivors still answer exactly.
+    import os
+
+    assert len(os.listdir(tmp_path)) == len(store.l2)
+    allk = np.unique(np.concatenate(batches))
+    assert store.probe(allk).all()
+
+
+def test_store_requires_spill_dir_with_host_budget():
+    with pytest.raises(ValueError, match="spill_dir"):
+        TieredVisitedStore(host_budget_mib=1.0, prefix="t_bad")
+
+
+# -- checker knob validation ----------------------------------------------
+
+
+def test_checker_rejects_host_budget_without_hbm_budget(tmp_path):
+    with pytest.raises(ValueError, match="hbm_budget_mib"):
+        TwoPhaseSys(3).checker().spawn_tpu_bfs(
+            frontier_capacity=16, table_capacity=1 << 10,
+            host_budget_mib=1.0, spill_dir=str(tmp_path),
+        )
+
+
+def test_checker_rejects_budget_below_one_wave():
+    # One worst-case wave must fit a freshly-evicted table, or the
+    # grow-and-retry loop could never terminate.
+    with pytest.raises(ValueError, match="worst-case wave"):
+        TwoPhaseSys(3).checker().spawn_tpu_bfs(
+            frontier_capacity=1 << 10, table_capacity=1 << 10,
+            hbm_budget_mib=0.001,
+        )
+
+
+# -- tier-1 eviction smoke -------------------------------------------------
+
+
+def test_l0_eviction_smoke_fast():
+    """An L0→L1 eviction end to end on CPU, steady-state under a second:
+    the smallest admissible budget on 2pc-3 evicts on the first pregrow,
+    and the run is capped after a handful of waves so the test budgets
+    the eviction + probe machinery, not a full-space traversal (the
+    equivalence suite owns exact-count preservation)."""
+    m = TwoPhaseSys(3)
+    rows = min_table_rows(16, m.packed_action_count())
+    # Best of two: the first run in a fresh process pays one-time
+    # tracing/dispatch costs the per-checker warmup stamp cannot fully
+    # attribute; the second run is the steady-state figure the satellite
+    # budget (<1s) is about.
+    steady = []
+    for _ in range(2):
+        metrics_registry().reset()
+        t0 = time.perf_counter()
+        checker = (
+            TwoPhaseSys(3)
+            .checker()
+            .target_state_count(150)
+            .spawn_tpu_bfs(
+                frontier_capacity=16,
+                table_capacity=1 << 12,
+                hbm_budget_mib=budget_for_table(rows),
+            )
+            .join()
+        )
+        wall = time.perf_counter() - t0
+        assert checker.worker_error() is None
+        assert 0 < checker.unique_state_count() <= 288
+        snap = metrics_registry().snapshot()
+        assert snap["tpu_bfs.storage.evictions"] >= 1
+        assert snap["tpu_bfs.storage.probe_keys"] > 0
+        steady.append(wall - (checker.warmup_seconds or 0.0))
+        if steady[-1] < 1.0:
+            break
+    assert min(steady) < 1.0, (
+        f"eviction smoke steady state took {min(steady):.2f}s"
+    )

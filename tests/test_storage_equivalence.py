@@ -1,0 +1,268 @@
+"""Tiny-budget out-of-core equivalence: a checker under ``hbm_budget_mib``
+pressure (multiple L0 evictions, host-tier probes on every wave) must be
+BIT-IDENTICAL to the unbounded single-tier run — state counts, unique
+counts, depths, discovery fingerprints, and the golden WriteReporter
+strings. The argument: the tier union is exactly the visited set, each
+key's first global appearance is the only one surviving the two-phase
+filter, and the survivor gather preserves lane order, so the frontier
+sequence never diverges (storage/__init__.py).
+
+Fast lane: 2pc-4 (materializing pipeline, deep-drain→wave handoff),
+2pc-4 under symmetry (orbit-key probe path), and a mid-eviction
+checkpoint resume. Slow lane: the 2pc-5 acceptance run, ABD with
+``expand_fps`` on/off, and the sharded checker with disk spill (L2).
+"""
+
+import io
+import math
+import pickle
+import re
+
+import pytest
+
+from stateright_tpu import WriteReporter
+from stateright_tpu.models.two_phase_commit import TwoPhaseSys
+from stateright_tpu.telemetry import metrics_registry
+
+
+def _golden(checker):
+    out = io.StringIO()
+    checker.report(WriteReporter(out))
+    # The wall-clock field is the only permitted difference.
+    return re.sub(r"sec=\d+", "sec=_", out.getvalue())
+
+
+def budget_for_table(rows: int) -> float:
+    return ((rows + 128) * 8) / (1 << 20)
+
+
+def min_table_rows(frontier: int, actions: int, load=0.55) -> int:
+    return 1 << math.ceil(math.log2(frontier * actions / load + 1))
+
+
+def tiny_budget(model, frontier: int, load=0.55) -> float:
+    """The smallest admissible ``hbm_budget_mib`` for this model at this
+    frontier width — the maximum eviction pressure the checker accepts."""
+    return budget_for_table(
+        min_table_rows(frontier, model.packed_action_count(), load)
+    )
+
+
+@pytest.fixture(scope="module")
+def unbounded_2pc4():
+    """One unbounded 2pc-4 reference run shared by the fast-lane tests
+    (same spawn config everywhere it is compared against)."""
+    checker = (
+        TwoPhaseSys(4)
+        .checker()
+        .spawn_tpu_bfs(frontier_capacity=16, table_capacity=1 << 12)
+        .join()
+    )
+    assert checker.worker_error() is None
+    return checker
+
+
+def _assert_identical(budgeted, unbounded, min_evictions, prefix="tpu_bfs"):
+    assert budgeted.worker_error() is None
+    assert unbounded.worker_error() is None
+    assert budgeted.unique_state_count() == unbounded.unique_state_count()
+    assert budgeted.state_count() == unbounded.state_count()
+    assert budgeted.max_depth() == unbounded.max_depth()
+    assert budgeted._discoveries_fp == unbounded._discoveries_fp
+    assert _golden(budgeted) == _golden(unbounded)
+    snap = metrics_registry().snapshot()
+    evictions = snap.get(f"{prefix}.storage.evictions", 0)
+    assert evictions >= min_evictions, (
+        f"budget never bound: {evictions} evictions "
+        f"(needed >= {min_evictions})"
+    )
+
+
+def test_budget_identical_2pc4(unbounded_2pc4):
+    """Materializing pipeline under eviction pressure, including the
+    deep-drain → wave-mode handoff at the first eviction."""
+    metrics_registry().reset()
+    budgeted = (
+        TwoPhaseSys(4)
+        .checker()
+        .spawn_tpu_bfs(
+            frontier_capacity=16,
+            table_capacity=1 << 12,
+            hbm_budget_mib=tiny_budget(TwoPhaseSys(4), 16),
+        )
+        .join()
+    )
+    _assert_identical(budgeted, unbounded_2pc4, min_evictions=2)
+    assert budgeted.unique_state_count() == 1568
+    budgeted.assert_properties()
+
+
+def test_budget_identical_2pc4_symmetry():
+    """Orbit-key probe path: under symmetry the visited keys are
+    canonical-form fingerprints; the host tier must store and probe THAT
+    key space (and the filtered key log keeps checkpoints coherent)."""
+    metrics_registry().reset()
+    budgeted = (
+        TwoPhaseSys(4)
+        .checker()
+        .symmetry()
+        .spawn_tpu_bfs(
+            frontier_capacity=8,
+            table_capacity=1 << 12,
+            hbm_budget_mib=tiny_budget(TwoPhaseSys(4), 8),
+        )
+        .join()
+    )
+    unbounded = (
+        TwoPhaseSys(4)
+        .checker()
+        .symmetry()
+        .spawn_tpu_bfs(frontier_capacity=8, table_capacity=1 << 12)
+        .join()
+    )
+    _assert_identical(budgeted, unbounded, min_evictions=1)
+
+
+def test_checkpoint_mid_eviction_resume(tmp_path, unbounded_2pc4):
+    """A checkpoint written AFTER evictions (runs + Bloom filters in the
+    payload, format v2) restores — runs CRC-validated, L0 rebuilt as
+    keys-not-in-runs — and finishes bit-identical to the unbounded run."""
+    ckpt = tmp_path / "2pc4-oob.ckpt"
+    budget = tiny_budget(TwoPhaseSys(4), 16)
+    metrics_registry().reset()
+    first = (
+        TwoPhaseSys(4)
+        .checker()
+        .target_state_count(2500)  # stop early, mid-space
+        .spawn_tpu_bfs(
+            frontier_capacity=16,
+            table_capacity=1 << 12,
+            hbm_budget_mib=budget,
+            checkpoint_path=str(ckpt),
+            checkpoint_every_chunks=4,
+        )
+        .join()
+    )
+    assert first.worker_error() is None
+    assert first.unique_state_count() < 1568
+    snap = metrics_registry().snapshot()
+    assert snap["tpu_bfs.storage.evictions"] >= 1
+    with open(ckpt, "rb") as f:
+        payload = pickle.load(f)
+    assert payload["version"] == 2
+    assert payload["storage"]["l1"] or payload["storage"]["l2"], (
+        "checkpoint written mid-eviction must carry the tier runs"
+    )
+
+    resumed = (
+        TwoPhaseSys(4)
+        .checker()
+        .spawn_tpu_bfs(
+            frontier_capacity=16,
+            table_capacity=1 << 12,
+            hbm_budget_mib=budget,
+            resume_from=str(ckpt),
+        )
+        .join()
+    )
+    _assert_identical(resumed, unbounded_2pc4, min_evictions=1)
+    assert resumed.unique_state_count() == 1568
+    resumed.assert_properties()
+
+
+@pytest.mark.slow
+def test_budget_identical_2pc5_acceptance():
+    """The acceptance run: 2pc-5 with the budget forcing >= 2 evictions,
+    bit-identical counts/discoveries/golden output to unbounded."""
+    metrics_registry().reset()
+    budgeted = (
+        TwoPhaseSys(5)
+        .checker()
+        .spawn_tpu_bfs(
+            frontier_capacity=16,
+            table_capacity=1 << 14,
+            hbm_budget_mib=tiny_budget(TwoPhaseSys(5), 16),
+        )
+        .join()
+    )
+    unbounded = (
+        TwoPhaseSys(5)
+        .checker()
+        .spawn_tpu_bfs(frontier_capacity=16, table_capacity=1 << 14)
+        .join()
+    )
+    _assert_identical(budgeted, unbounded, min_evictions=2)
+    assert budgeted.unique_state_count() == 8832
+    budgeted.assert_properties()
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("fps", [True, False])
+def test_budget_identical_abd_expand_fps(fps):
+    """ABD register, fingerprint-only expansion on/off: the fps wave's
+    survivor path materializes only probed-fresh children; both pipelines
+    must stay bit-identical to their unbounded twins."""
+    from stateright_tpu.models.linearizable_register import AbdModelCfg
+
+    def spawn(**kw):
+        return (
+            AbdModelCfg(2, 2)
+            .into_model()
+            .checker()
+            .spawn_tpu_bfs(
+                frontier_capacity=8,
+                table_capacity=1 << 12,
+                expand_fps=fps,
+                **kw,
+            )
+            .join()
+        )
+
+    metrics_registry().reset()
+    model = AbdModelCfg(2, 2).into_model()
+    budgeted = spawn(hbm_budget_mib=tiny_budget(model, 8))
+    unbounded = spawn()
+    _assert_identical(budgeted, unbounded, min_evictions=2)
+    assert budgeted.unique_state_count() == 544
+
+
+@pytest.mark.slow
+def test_sharded_budget_identical_with_spill(tmp_path):
+    """Sharded checker: per-shard tiers, disk spill (L2) under a host
+    budget, and bit-identical results. The unbounded twin runs
+    wave-at-a-time too (the budgeted path forces it, and sharded deep
+    drains label depths at first-claim rather than minimal)."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.array(jax.devices()[:4]), ("fp",))
+
+    def spawn(**kw):
+        return (
+            TwoPhaseSys(5)
+            .checker()
+            .spawn_sharded_tpu_bfs(
+                mesh=mesh,
+                frontier_per_device=8,
+                table_capacity_per_device=1 << 14,
+                **kw,
+            )
+            .join()
+        )
+
+    A = TwoPhaseSys(5).packed_action_count()
+    rows = 1 << math.ceil(math.log2(4 * 8 * A / 0.5 + 1))
+    metrics_registry().reset()
+    budgeted = spawn(
+        hbm_budget_mib=budget_for_table(rows),
+        host_budget_mib=0.02,
+        spill_dir=str(tmp_path),
+    )
+    unbounded = spawn(max_drain_waves=1)
+    _assert_identical(
+        budgeted, unbounded, min_evictions=2, prefix="sharded_bfs"
+    )
+    assert budgeted.unique_state_count() == 8832
+    snap = metrics_registry().snapshot()
+    assert snap["sharded_bfs.storage.spills"] >= 1, "host budget never spilled"
