@@ -298,10 +298,6 @@ def _run_leg(leg: str, pin_cpu: bool):
     # overhead is quantifiable against the unbounded r05 numbers.
     budget = _parse_float_flag("--hbm-budget-mib")
     host_budget = _parse_float_flag("--host-budget-mib")
-    if host_budget is not None and budget is None:
-        # Same hazard class as a silently-dropped --dedup: the spawn
-        # would reject this combination, so the flag must not no-op.
-        raise SystemExit("--host-budget-mib requires --hbm-budget-mib")
     if budget is not None:
         spec["spawn"]["hbm_budget_mib"] = budget
         if host_budget is not None:
@@ -341,8 +337,37 @@ def _run_leg(leg: str, pin_cpu: bool):
         os.remove(progress_path)
     except OSError:
         pass
-    checker = builder.spawn_tpu_bfs(**spec["spawn"])
+    # Live monitoring (--monitor-port): /metrics (Prometheus), /status
+    # (JSON progress + ETA band), /events (SSE wave stream) served
+    # concurrently with the check; the flight recorder rides along so a
+    # SIGTERM'd (wedged-tunnel-timeout) leg leaves flight-<run_id>.json
+    # forensics, and --stall-deadline-s arms the no-wave watchdog.
+    # Created BEFORE spawn (the documented pattern): the worker thread
+    # can finish waves of a short leg before a late-attached sink would
+    # see them, skewing the wave/ETA counters.
+    monitor = None
+    monitor_port = _parse_float_flag("--monitor-port")
+    stall_deadline_s = _parse_float_flag("--stall-deadline-s")
+    if monitor_port is not None:
+        from stateright_tpu.telemetry.server import MonitorServer
+
+        monitor = MonitorServer(
+            port=int(monitor_port),
+            run_id=f"{leg}-{os.getpid()}",
+            stall_deadline_s=stall_deadline_s,
+            flight_recorder=True,
+            flight_dir=RUNTIME_DIR,
+        )
+        out["monitor_port"] = monitor.port
+        log(f"[{leg}] monitor serving at {monitor.url}")
+    checker = None
     try:
+        # Spawn inside the try: a spawn-time failure (bad knob, device
+        # init) must still flight-dump and close the monitor below, not
+        # leak its server thread / watchdog / tracer sink.
+        checker = builder.spawn_tpu_bfs(**spec["spawn"])
+        if monitor is not None:
+            monitor.attach(checker)
         while not checker.is_done():
             time.sleep(2.0)
             try:
@@ -371,6 +396,28 @@ def _run_leg(leg: str, pin_cpu: bool):
         checker.join()
         dt = time.time() - t0
     finally:
+        if monitor is not None:
+            # A worker error propagates AFTER this finally uninstalls the
+            # excepthook — and a main-thread exception reaches the hook
+            # only after monitor.close() has restored the original one —
+            # so the crash dump must happen here or a crashed monitored
+            # leg would leave no flight file at all.
+            werr = checker.worker_error() if checker is not None else None
+            exc = None
+            if werr is not None:
+                exc = ("worker_error", (type(werr), werr, werr.__traceback__))
+            else:
+                inflight = sys.exc_info()
+                if inflight[0] is not None:
+                    exc = ("exception", inflight)
+            if exc is not None and monitor.flight is not None:
+                try:
+                    monitor.flight.dump(exc[0], exc=exc[1])
+                except Exception as dump_err:  # noqa: BLE001
+                    # A failed dump (disk full — plausibly what killed
+                    # the run) must not supersede the real error.
+                    log(f"[{leg}] flight dump failed: {dump_err!r}")
+            monitor.close()
         try:
             os.remove(progress_path)
         except OSError:
@@ -563,10 +610,16 @@ def _parse_float_flag(flag: str):
 
 
 def _budget_override_args():
-    """Parent-level out-of-core flags must reach every leg child (the
-    same silently-no-op hazard ``--dedup`` had)."""
+    """Parent-level out-of-core and monitor flags must reach every leg
+    child (the same silently-no-op hazard ``--dedup`` had). The monitor
+    port is shared safely: legs run sequentially, one child at a time."""
     args = []
-    for flag in ("--hbm-budget-mib", "--host-budget-mib"):
+    for flag in (
+        "--hbm-budget-mib",
+        "--host-budget-mib",
+        "--monitor-port",
+        "--stall-deadline-s",
+    ):
         value = _parse_float_flag(flag)
         if value is not None:
             args += [flag, str(value)]
@@ -656,7 +709,25 @@ def _sentinel_device_results():
     return out or None
 
 
+def _validate_flag_combos():
+    """Fail dependent-flag combos up front, before any work: in
+    full-bench mode a bad combo would otherwise be forwarded to every
+    leg child, each burning its timeout on rc=1 + a CPU-pinned fallback
+    retry (same must-not-no-op rule as ``--dedup``: a flag the user
+    asked for that silently never arms is worse than an error)."""
+    for flag, needs in (
+        ("--stall-deadline-s", "--monitor-port"),
+        ("--host-budget-mib", "--hbm-budget-mib"),
+    ):
+        if (
+            _parse_float_flag(flag) is not None
+            and _parse_float_flag(needs) is None
+        ):
+            raise SystemExit(f"{flag} requires {needs}")
+
+
 def main():
+    _validate_flag_combos()
     if "--breakdown" in sys.argv:
         return _run_breakdown(
             sys.argv[sys.argv.index("--breakdown") + 1], "--cpu" in sys.argv

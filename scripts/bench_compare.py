@@ -1,0 +1,283 @@
+#!/usr/bin/env python
+"""Diff bench trajectory files into a per-leg delta table with a
+regression gate.
+
+    python scripts/bench_compare.py BENCH_r05.json BENCH_r06.json
+    python scripts/bench_compare.py BENCH_r*.json            # trajectory
+    python scripts/bench_compare.py BENCH_r05.json /tmp/leg.json \
+        --as-leg smoke --threshold 0.25
+
+With exactly two inputs, prints old-vs-new per-leg rates and exits
+nonzero iff any shared leg's rate regresses past ``--threshold``
+(fraction, default 0.10) — the CI-checkable gate the bench trajectory
+never had. With more inputs, prints the whole trajectory (legs x files;
+no gate). Legs the bench marks advisory (``<leg>_advisory``: sub-second
+steady windows, not rate claims) are shown but never gate.
+
+Accepted input shapes, sniffed per file:
+
+- a ``BENCH_r*.json`` wrapper (``{"parsed": {...}, "tail": "..."}``) —
+  uses ``parsed`` when present, else regex-salvages rates out of the
+  ``tail`` (which may be truncated mid-line: killed benches tear it);
+- the raw ``bench.py`` output line itself (``{"metric": ..., "value":
+  ..., "<leg>_rate": ...}``);
+- a single leg child's JSON line (``{"rate": ..., "unique": ...}``) —
+  named via ``--as-leg`` (default: the file stem).
+
+Stdlib-only: trajectory files outlive the runs that wrote them and must
+stay comparable on boxes without jax.
+"""
+
+from __future__ import annotations
+
+import argparse
+import io
+import json
+import os
+import re
+import sys
+
+# The primary 2pc leg rides the headline "value" field; every other leg
+# is "<leg>_rate". Salvage both shapes straight out of (possibly torn)
+# text so a truncated tail still yields every complete key it carries.
+# The delimiter lookahead is load-bearing: a tail torn mid-number
+# ('"value": 123' from '"value": 123456.7') must be DROPPED, not
+# salvaged as a rate that is wrong by orders of magnitude.
+_LEG_RATE_RE = re.compile(
+    r'"([A-Za-z0-9_]+)_rate"\s*:\s*([0-9.eE+-]+)(?=[,}\s])'
+)
+_VALUE_RE = re.compile(r'"value"\s*:\s*([0-9.eE+-]+)(?=[,}\s])')
+_ADVISORY_RE = re.compile(r'"([A-Za-z0-9_]+)_advisory"\s*:\s*true')
+
+PRIMARY_LEG = "2pc"
+
+
+def _rates_from_text(text):
+    rates, advisory = {}, set()
+    m = _VALUE_RE.search(text)
+    if m:
+        try:
+            rates[PRIMARY_LEG] = float(m.group(1))
+        except ValueError:
+            pass  # interleaved-write garbage ('1.23.4'): DROP, don't die
+    for leg, value in _LEG_RATE_RE.findall(text):
+        if leg == "host":  # host_rate is the baseline, not a leg
+            continue
+        try:
+            rates[leg] = float(value)
+        except ValueError:
+            pass
+    for (leg,) in (m.groups() for m in _ADVISORY_RE.finditer(text)):
+        advisory.add(leg)
+    return rates, advisory
+
+
+def _rates_from_line(line: dict):
+    rates, advisory = {}, set()
+    if "value" in line:
+        try:
+            rates[PRIMARY_LEG] = float(line["value"])
+        except (TypeError, ValueError):
+            pass  # null/garbage from a torn or hand-edited file: DROP
+    for key, value in line.items():
+        if key.endswith("_rate") and key != "host_rate":
+            try:
+                rates[key[: -len("_rate")]] = float(value)
+            except (TypeError, ValueError):
+                pass
+        if key.endswith("_advisory") and value:
+            advisory.add(key[: -len("_advisory")])
+    return rates, advisory
+
+
+def load_rates(path, as_leg=None):
+    """``(rates {leg: states/s}, advisory legs, note)`` for one file."""
+    with open(path) as f:
+        text = f.read()
+    try:
+        obj = json.loads(text)
+    except json.JSONDecodeError:
+        rates, advisory = _rates_from_text(text)
+        return rates, advisory, "unparseable JSON; regex salvage"
+    if isinstance(obj, dict) and ("tail" in obj or "parsed" in obj):
+        parsed = obj.get("parsed")
+        if isinstance(parsed, dict):
+            rates, advisory = _rates_from_line(parsed)
+            return rates, advisory, None
+        rates, advisory = _rates_from_text(obj.get("tail") or "")
+        return rates, advisory, "no parsed line; salvaged from tail"
+    if isinstance(obj, dict) and ("value" in obj or "metric" in obj):
+        rates, advisory = _rates_from_line(obj)
+        return rates, advisory, None
+    if isinstance(obj, dict) and "rate" in obj:
+        leg = as_leg or os.path.splitext(os.path.basename(path))[0]
+        advisory = {leg} if obj.get("advisory") else set()
+        try:
+            return {leg: float(obj["rate"])}, advisory, None
+        except (TypeError, ValueError):
+            return {}, set(), "null/garbage rate; dropped"
+    return {}, set(), "unrecognized shape"
+
+
+def compare(old, new, threshold, out=sys.stdout):
+    """Old-vs-new delta table; returns the legs breaching the gate.
+
+    A non-advisory leg present in old but MISSING from new gates too —
+    a leg that crashed entirely is worse than one that merely slowed —
+    but only when the files share at least one leg (zero overlap means
+    the inputs aren't comparable trajectories, e.g. a bench line vs a
+    single fresh leg: table only, caller warned), and only when the new
+    side was fully parsed: in a torn-tail salvage a missing key is
+    indistinguishable from truncation, so absence there cannot convict."""
+    old_rates, old_adv, _ = old
+    new_rates, new_adv, new_note = new
+    legs = sorted(set(old_rates) | set(new_rates))
+    comparable = bool(set(old_rates) & set(new_rates))
+    new_complete = new_note is None
+    breaches = []
+    header = (
+        f"{'leg':<10} {'old /s':>12} {'new /s':>12} {'delta':>8}  flag"
+    )
+    out.write(header + "\n" + "-" * len(header) + "\n")
+    for leg in legs:
+        a, b = old_rates.get(leg), new_rates.get(leg)
+        if a is None or b is None:
+            dropped = b is None
+            gates = (
+                dropped and comparable and new_complete
+                and leg not in old_adv
+            )
+            if gates:
+                breaches.append(leg)
+            flag = (
+                "DROPPED (gate)" if gates
+                else "(dropped?)" if dropped and not new_complete
+                else "(dropped)" if dropped
+                else "(new leg)"
+            )
+            out.write(
+                f"{leg:<10} {_fmt(a):>12} {_fmt(b):>12} {'':>8}  {flag}\n"
+            )
+            continue
+        delta = (b - a) / a if a else 0.0
+        advisory = leg in old_adv or leg in new_adv
+        breached = delta < -threshold and not advisory
+        if breached:
+            breaches.append(leg)
+        flag = (
+            "REGRESSION" if breached
+            else "advisory" if advisory and delta < -threshold
+            else ""
+        )
+        out.write(
+            f"{leg:<10} {a:>12,.1f} {b:>12,.1f} {delta:>+7.1%}  {flag}\n"
+        )
+    if not comparable:
+        print(
+            "warning: no shared legs between the two inputs; "
+            "nothing gated",
+            file=sys.stderr,
+        )
+    return breaches
+
+
+def trajectory(loaded, out=sys.stdout):
+    """Legs x files rate table over the whole trajectory (no gate)."""
+    names = [os.path.basename(p) for p, _ in loaded]
+    legs = sorted({leg for _, (rates, _, _) in loaded for leg in rates})
+    width = max(12, max((len(n) for n in names), default=12) + 1)
+    out.write(f"{'leg':<10}" + "".join(f"{n:>{width}}" for n in names) + "\n")
+    for leg in legs:
+        row = f"{leg:<10}"
+        for _, (rates, _, _) in loaded:
+            value = rates.get(leg)
+            row += f"{_fmt(value):>{width}}"
+        out.write(row + "\n")
+
+
+def _fmt(value):
+    return f"{value:,.1f}" if value is not None else "-"
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="Per-leg rate deltas between bench trajectory files, "
+        "with a regression threshold gate."
+    )
+    parser.add_argument("files", nargs="+", help="BENCH_r*.json (or raw "
+                        "bench/leg JSON lines); 2 = gated diff, 3+ = "
+                        "trajectory table")
+    parser.add_argument(
+        "--threshold", type=float, default=None,
+        help="max tolerated fractional rate drop per leg (default 0.10); "
+        "exceeded => exit 1. With 3+ files the trajectory table prints "
+        "and an explicit --threshold gates the newest step",
+    )
+    parser.add_argument(
+        "--legs", help="comma-separated leg filter (default: all)"
+    )
+    parser.add_argument(
+        "--as-leg",
+        help="leg name for bare single-leg result files (bench.py --leg "
+        "output); default: the file stem",
+    )
+    args = parser.parse_args(argv)
+
+    loaded = []
+    for path in args.files:
+        try:
+            rates, advisory, note = load_rates(path, as_leg=args.as_leg)
+        except OSError as e:
+            print(f"error: {path}: {e}", file=sys.stderr)
+            return 2
+        if note:
+            print(f"note: {path}: {note}", file=sys.stderr)
+        if not rates:
+            print(f"error: {path}: no leg rates found", file=sys.stderr)
+            return 2
+        if args.legs:
+            keep = set(args.legs.split(","))
+            rates = {k: v for k, v in rates.items() if k in keep}
+            if not rates:
+                # A typo'd filter must not turn the gate vacuously green.
+                print(
+                    f"error: {path}: --legs {args.legs!r} matches no leg",
+                    file=sys.stderr,
+                )
+                return 2
+        loaded.append((path, (rates, advisory, note)))
+
+    threshold = 0.10 if args.threshold is None else args.threshold
+
+    def gate(base, cand, base_path, out=sys.stdout):
+        breaches = compare(base, cand, threshold=threshold, out=out)
+        if breaches:
+            print(
+                f"REGRESSION: {', '.join(breaches)} regressed past "
+                f"{threshold:.0%} (or vanished) vs {base_path}",
+                file=sys.stderr,
+            )
+            return 1
+        return 0
+
+    if len(loaded) == 2:
+        return gate(loaded[0][1], loaded[1][1], loaded[0][0])
+    trajectory(loaded)
+    if args.threshold is not None:
+        # An explicit threshold must never be a silent no-op: gate the
+        # newest step of the trajectory (table already printed above).
+        if len(loaded) < 2:
+            print(
+                "error: --threshold needs at least two files to gate "
+                "(usage error, not a regression)",
+                file=sys.stderr,
+            )
+            return 2
+        return gate(
+            loaded[-2][1], loaded[-1][1], loaded[-2][0], out=io.StringIO()
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,107 @@
+#!/usr/bin/env python
+"""Render a flight-recorder crash dump (``flight-<run_id>.json``).
+
+    python scripts/flight_report.py flight-20260803-1234.json [--waves N]
+
+The flight recorder (``stateright_tpu/telemetry/server.py``) dumps on
+uncaught exception or SIGTERM/SIGINT: run identity + reason, the
+exception traceback when there was one, the checker's state digest
+(depth, counts, table capacity, storage tier stats, checkpoint path),
+a full metrics snapshot, and the tracer ring buffer (the final waves
+before death). This renders it: header, digest, scalar metrics, and the
+last ``--waves`` wave-level spans as the usual per-wave table.
+
+Stdlib-only: flight files are read on whatever box the run died on.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from trace_summary import print_table, wave_rows  # noqa: E402
+
+
+def render(record: dict, waves: int = 20, out=sys.stdout) -> None:
+    out.write("flight recorder dump\n")
+    out.write("====================\n")
+    for key in ("run_id", "reason", "wall_time", "pid"):
+        out.write(f"{key:<12} {record.get(key)}\n")
+
+    exc = record.get("exception")
+    if exc:
+        out.write(f"\nexception: {exc.get('type')}: {exc.get('message')}\n")
+        tb = exc.get("traceback")
+        if tb:
+            out.write(tb if tb.endswith("\n") else tb + "\n")
+    else:
+        out.write("\nexception: none (signal or manual dump)\n")
+
+    digest = record.get("digest")
+    out.write("\ncheckpoint of record (state digest)\n")
+    out.write("-----------------------------------\n")
+    if isinstance(digest, dict):
+        for key, value in digest.items():
+            if key == "storage" and isinstance(value, dict):
+                out.write("storage:\n")
+                for sk, sv in value.items():
+                    out.write(f"  {sk:<22} {sv}\n")
+            else:
+                out.write(f"{key:<24} {value}\n")
+    else:
+        out.write(f"(none: {digest})\n")
+
+    metrics = record.get("metrics") or {}
+    scalars = {
+        k: v for k, v in sorted(metrics.items())
+        if not isinstance(v, dict) and v is not None
+    }
+    if scalars:
+        out.write("\nmetrics at death (scalars)\n")
+        out.write("--------------------------\n")
+        for key, value in scalars.items():
+            out.write(f"{key:<40} {value}\n")
+
+    ring = record.get("ring") or []
+    rows = wave_rows(ring)
+    out.write(
+        f"\nring buffer: {len(ring)} events, {len(rows)} wave-level "
+        f"spans (showing last {min(max(waves, 0), len(rows))})\n\n"
+    )
+    if rows and waves > 0:  # rows[-0:] would be ALL of them
+        print_table(rows[-waves:], out=out)
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="Render a flight-<run_id>.json crash dump."
+    )
+    parser.add_argument("flight", help="flight-*.json file")
+    parser.add_argument(
+        "--waves", type=int, default=20,
+        help="wave-level ring spans to show (default 20)",
+    )
+    args = parser.parse_args(argv)
+
+    try:
+        with open(args.flight) as f:
+            record = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"error: cannot read {args.flight}: {e}", file=sys.stderr)
+        return 2
+    if record.get("flight_recorder") != 1:
+        print(
+            f"error: {args.flight} is not a flight recorder dump",
+            file=sys.stderr,
+        )
+        return 2
+    render(record, waves=args.waves)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -25,18 +25,31 @@ import sys
 
 
 def load_events(path):
-    """Events from a JSONL trace; unparseable lines (a killed run's
-    partial tail write) are skipped, never fatal."""
+    """Events from a JSONL trace; unparseable lines (a killed run tears
+    the tail line; disk-full runs can tear any) are skipped with a
+    stderr count, never fatal."""
     events = []
-    with open(path) as f:
+    skipped = 0
+    with open(path, errors="replace") as f:
         for line in f:
             line = line.strip()
             if not line:
                 continue
             try:
-                events.append(json.loads(line))
+                event = json.loads(line)
             except json.JSONDecodeError:
+                skipped += 1
                 continue
+            if not isinstance(event, dict):
+                skipped += 1
+                continue
+            events.append(event)
+    if skipped:
+        print(
+            f"warning: skipped {skipped} unparseable line(s) in {path} "
+            "(torn write from a killed run?)",
+            file=sys.stderr,
+        )
     return events
 
 
@@ -103,6 +116,38 @@ def print_table(rows, out=sys.stdout):
     )
 
 
+def top_spans(events, n):
+    """The n slowest complete spans, any name — where the wall time went
+    (wave, drain, table_grow, storage evict/merge/probe alike)."""
+    spans = [
+        ev for ev in events
+        if ev.get("ph") == "X" and isinstance(ev.get("dur"), (int, float))
+    ]
+    return sorted(spans, key=lambda ev: -ev["dur"])[:n]
+
+
+def print_top(spans, out=sys.stdout):
+    header = f"{'#':>4} {'span':<26} {'ms':>10}  args"
+    out.write(header + "\n")
+    out.write("-" * len(header) + "\n")
+    for i, ev in enumerate(spans, 1):
+        args = ev.get("args") or {}
+        brief = " ".join(
+            f"{k}={args[k]}" for k in list(args)[:4]
+        )
+        out.write(
+            f"{i:>4} {ev.get('name', '?'):<26} "
+            f"{ev['dur'] / 1000.0:>10.2f}  {brief}\n"
+        )
+
+
+def _positive_int(value):
+    n = int(value)
+    if n <= 0:
+        raise argparse.ArgumentTypeError(f"expected N > 0, got {value}")
+    return n
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(
         description="Per-wave table from a telemetry trace JSONL."
@@ -111,6 +156,10 @@ def main(argv=None):
     parser.add_argument(
         "--chrome-out",
         help="also write Chrome trace-event JSON (Perfetto-loadable)",
+    )
+    parser.add_argument(
+        "--top", type=_positive_int, metavar="N",
+        help="also print the N slowest spans of any kind",
     )
     args = parser.parse_args(argv)
 
@@ -126,6 +175,9 @@ def main(argv=None):
             f"{len(events)} events, none with per-wave args "
             "(host block/trace spans only)",
         )
+    if args.top:
+        print()
+        print_top(top_spans(events, args.top))
     if args.chrome_out:
         with open(args.chrome_out, "w") as f:
             json.dump(

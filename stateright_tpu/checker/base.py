@@ -72,6 +72,47 @@ class Checker(Generic[State, Action]):
 
         return metrics_registry()
 
+    def serve_monitor(self, port: int = 0, **kwargs):
+        """Starts the live in-process monitor HTTP server for this run
+        (``stateright_tpu.telemetry.server.MonitorServer``): ``/metrics``
+        (Prometheus), ``/status`` (JSON progress + ETA), ``/events``
+        (SSE wave/storage stream). ``port=0`` binds an ephemeral port
+        (``monitor.port`` / ``monitor.url``); pass ``stall_deadline_s=``
+        to arm the watchdog and ``flight_recorder=True`` for crash
+        dumps. Returns the server; call ``monitor.close()`` when done."""
+        from ..telemetry.server import MonitorServer
+
+        return MonitorServer(checker=self, port=port, **kwargs)
+
+    def state_digest(self) -> dict:
+        """A cheap, never-raising summary of where the run stands — the
+        flight recorder's crash payload and the stall watchdog's context.
+        Backends extend it (device checkers add table capacity, storage
+        tier stats, checkpoint path); every field is individually guarded
+        because the digest is read mid-crash from arbitrary threads."""
+        digest: dict = {"backend": type(self).__name__}
+        for field, fn in (
+            ("done", self.is_done),
+            ("state_count", self.state_count),
+            ("unique_state_count", self.unique_state_count),
+            ("max_depth", self.max_depth),
+        ):
+            try:
+                digest[field] = fn()
+            except Exception:  # noqa: BLE001 - mid-crash best effort
+                digest[field] = None
+        try:
+            digest["discoveries"] = sorted(self._discovery_names())
+        except Exception:  # noqa: BLE001
+            digest["discoveries"] = None
+        return digest
+
+    def _discovery_names(self) -> List[str]:
+        """Discovery property names WITHOUT path reconstruction — the
+        digest must stay cheap and safe mid-run; backends holding a
+        fingerprint map override this."""
+        return list(self.discoveries())
+
     # -- complete-liveness plumbing (shared by every spawning checker) ------
 
     def _setup_lasso(self, options) -> None:

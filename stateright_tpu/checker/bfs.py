@@ -195,6 +195,7 @@ class BfsChecker(Checker):
                 generated=generated_count,
                 max_depth=block_max_depth,
                 unique_total=len(generated),
+                pending=len(pending),
             )
 
     # -- Checker surface ---------------------------------------------------

@@ -201,6 +201,7 @@ class DfsChecker(Checker):
                 generated=generated_count,
                 max_depth=block_max_depth,
                 unique_total=len(generated),
+                pending=len(pending),
             )
 
     # -- Checker surface ---------------------------------------------------

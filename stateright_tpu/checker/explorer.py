@@ -8,11 +8,17 @@ verified along the user's path of interest).
 HTTP surface (reference: ``/root/reference/src/checker/explorer.rs``):
 
 - ``GET /.status`` → ``StatusView`` JSON: progress counters, per-property
-  discovery paths, and a recently sampled path;
+  discovery paths, a recently sampled path, and the live-monitor
+  ``progress`` estimate (EWMA states/s, ETA band — the same fields the
+  monitor server's ``/status`` reports);
 - ``GET /.states/fp1/fp2/...`` → ``StateView`` JSON: replays the
   fingerprint path through the model, evaluates properties at the final
   state, renders the model's SVG hook, and enumerates next steps;
-- ``POST /.runtocompletion`` → unblocks the checker to exhaust the space.
+- ``POST /.runtocompletion`` → unblocks the checker to exhaust the space;
+- ``GET /metrics`` / ``/status`` / ``/events`` → the live-monitor
+  endpoints (Prometheus text, JSON snapshot, SSE wave/storage stream —
+  ``stateright_tpu/telemetry/server.py``), mounted on the same port so
+  the UI's dashboard panel needs no second server.
 
 The UI (``stateright_tpu/ui/``) is a small hand-written vanilla-JS page
 (the reference uses KnockoutJS; nothing is shared)."""
@@ -30,6 +36,7 @@ from ..core.fingerprint import fingerprint
 from ..core.model import Expectation
 from ..core.path import Path
 from ..core.visitor import CheckerVisitor
+from ..telemetry.server import MonitorCore, handle_monitor_get
 
 _UI_DIR = FsPath(__file__).resolve().parent.parent / "ui"
 _SNAPSHOT_RESET_SECONDS = 4.0
@@ -60,7 +67,8 @@ class Snapshot(CheckerVisitor):
 # -- view builders (route handlers minus HTTP, exercised directly by tests) --
 
 
-def status_view(checker, snapshot: Optional[Snapshot] = None) -> dict:
+def status_view(checker, snapshot: Optional[Snapshot] = None,
+                monitor: Optional[MonitorCore] = None) -> dict:
     model = checker.model()
     properties = []
     discoveries = checker.discoveries()
@@ -83,6 +91,10 @@ def status_view(checker, snapshot: Optional[Snapshot] = None) -> dict:
         "max_depth": checker.max_depth(),
         "properties": properties,
         "recent_path": _encode_path(model, recent) if recent else None,
+        # The live-monitor progress estimate (same fields as the monitor
+        # server's /status): fed by the on-demand checker's block spans
+        # when a MonitorCore is attached, null for bare view calls.
+        "progress": monitor.estimator.snapshot() if monitor else None,
     }
 
 
@@ -188,12 +200,13 @@ _CONTENT_TYPES = {
 class _Handler(BaseHTTPRequestHandler):
     checker = None
     snapshot = None
+    monitor = None
 
     def log_message(self, *args):  # quiet by default
         pass
 
     def _json(self, payload, code=200):
-        body = json.dumps(payload).encode()
+        body = json.dumps(payload, default=str).encode()
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
@@ -202,8 +215,14 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_GET(self):
         try:
+            # Live-monitor endpoints (/metrics, /status, /events) mount
+            # ahead of the Explorer routes and static files.
+            if handle_monitor_get(self, self.monitor, self.path):
+                return
             if self.path == "/.status":
-                self._json(status_view(self.checker, self.snapshot))
+                self._json(
+                    status_view(self.checker, self.snapshot, self.monitor)
+                )
             elif self.path.startswith("/.states"):
                 raw = [p for p in self.path[len("/.states") :].split("/") if p]
                 try:
@@ -217,7 +236,11 @@ class _Handler(BaseHTTPRequestHandler):
                     self._json({"error": str(e)}, 404)
             else:
                 self._static(self.path)
-        except BrokenPipeError:
+        except ConnectionError:
+            # Routine client disconnect mid-response (scraper timeout,
+            # closed browser tab) must not traceback-spam the server —
+            # but only disconnects: a filesystem error in _static must
+            # still surface.
             pass
 
     def do_POST(self):
@@ -249,15 +272,41 @@ class _Handler(BaseHTTPRequestHandler):
         self.wfile.write(body)
 
 
+class _ExplorerServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer that also tears down the attached live-monitor
+    core (tracer sink + SSE broker + watchdog) on shutdown, so test and
+    embedder lifecycles stay one call."""
+
+    daemon_threads = True
+    monitor_core: Optional[MonitorCore] = None
+
+    def shutdown(self):
+        if self.monitor_core is not None:
+            self.monitor_core.close()
+        super().shutdown()
+
+
 def start_server(builder, address) -> tuple:
     """Spawns the on-demand checker + HTTP server; returns
-    ``(server, checker)`` without blocking (used by tests and ``serve``)."""
+    ``(server, checker)`` without blocking (used by tests and ``serve``).
+    A ``MonitorCore`` rides along, so every Explorer also serves the live
+    ``/metrics``, ``/status``, and ``/events`` monitor endpoints."""
     snapshot = Snapshot()
     checker = builder.visitor(snapshot).spawn_on_demand()
+    monitor = MonitorCore(checker=checker)
     handler = type(
-        "Handler", (_Handler,), {"checker": checker, "snapshot": snapshot}
+        "Handler",
+        (_Handler,),
+        {"checker": checker, "snapshot": snapshot, "monitor": monitor},
     )
-    server = ThreadingHTTPServer(_parse_address(address), handler)
+    try:
+        server = _ExplorerServer(_parse_address(address), handler)
+    except BaseException:
+        # A failed bind must not leave the core as an orphaned tracer
+        # sink overwriting the shared monitor.* gauges forever.
+        monitor.close()
+        raise
+    server.monitor_core = monitor
     thread = threading.Thread(
         target=server.serve_forever, name="explorer-http", daemon=True
     )

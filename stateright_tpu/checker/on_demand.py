@@ -242,6 +242,7 @@ class OnDemandChecker(Checker):
                 generated=generated_count,
                 max_depth=block_max_depth,
                 unique_total=len(generated),
+                pending=len(targetted) + len(pending),
             )
 
     # -- Checker surface ---------------------------------------------------

@@ -1475,14 +1475,18 @@ class TpuBfsChecker(Checker):
         self._wi.compaction.set(live / width if width else 0.0)
         self._wi.frontier_fill.set(live / self._F_max)
 
-    def _consume_wave(self, table, wave, chunk, queue, depth_cap, span=None):
+    def _consume_wave(self, table, wave, chunk, queue, depth_cap, span=None,
+                      pending=None):
         """Applies one wave output host-side (counters, discoveries, log,
         requeue), retrying the producing frontier after table growth until
         no probe overflows. Returns ``(table, wave_new)`` — the updated
         table and the wave's fresh-unique count (the deep loop uses it as
         the exact live size of the chunks spilled into the host queue).
         ``span`` (optional, a telemetry span covering this wave) is filled
-        with the per-wave quantities the acceptance trace carries."""
+        with the per-wave quantities the acceptance trace carries;
+        ``pending`` (deep-drain path) is the ring's residual count, so the
+        span's ``live_lanes`` = pending + this wave's spill — the exact
+        live frontier at the drain boundary."""
         props = self._properties
         attempt = 0
         generated = 0
@@ -1559,7 +1563,7 @@ class TpuBfsChecker(Checker):
             if not int(stats[2]):
                 self._record_wave_metrics(
                     span, chunk["hi"].shape[0], generated, wave_new,
-                    stale=stale_total,
+                    stale=stale_total, pending=pending,
                 )
                 return table, wave_new
             if self._max_capacity is not None and attempt >= 8:
@@ -1577,13 +1581,22 @@ class TpuBfsChecker(Checker):
             wave = None
 
     def _record_wave_metrics(self, span, frontier, generated, n_new,
-                             stale=None):
+                             stale=None, pending=None):
         """One wave's telemetry (the shared bundle does the recording).
         Occupancy is the TABLE's (L0-resident keys over capacity) — under
         tiering the global unique count keeps growing past what the
         device holds."""
         bucket, live = self._last_dispatch or (None, None)
+        # `live` stays the last DISPATCH's live lanes (the compaction
+        # denominator pairs with it); the monitor-facing live frontier is
+        # separate — at a deep-drain boundary it is the ring residue plus
+        # this wave's spill (the next drain's bucket selector input).
+        live_lanes = pending + n_new if pending is not None else live
         extra = {}
+        if live_lanes is not None:
+            # Live (pre-padding) lanes: the monitor's frontier fit reads
+            # this over the dispatch-width `frontier` when present.
+            extra["live_lanes"] = live_lanes
         if self._tier is not None:
             self._tier.instruments.set_l0(self._l0_count)
             extra["storage_stale"] = stale or 0
@@ -1828,7 +1841,10 @@ class TpuBfsChecker(Checker):
                     max_depth=self._max_depth,
                     count_wave=False,
                     observe=False,
-                    waves=waves_n,
+                    # Final unconsumed wave rides the _consume_wave span
+                    # below — same minus-one as the waves counter above,
+                    # so monitor /status waves match the registry.
+                    waves=max(waves_n - 1, 0),
                     log_n=log_n,
                     ring_count=int(dstats[5]),
                     bucket=width,
@@ -1850,7 +1866,7 @@ class TpuBfsChecker(Checker):
             with self._tracer.span("tpu_bfs.wave", drain=drains) as sp:
                 table, spilled = self._consume_wave(
                     table, res["out"], res["frontier"], queue, depth_cap,
-                    span=sp,
+                    span=sp, pending=pool_count,
                 )
             # Exact pending live lanes: the ring's count plus the final
             # wave's fresh spill — the next drain's bucket selector input.
@@ -2229,3 +2245,24 @@ class TpuBfsChecker(Checker):
 
     def worker_error(self) -> Optional[BaseException]:
         return self._error
+
+    def _discovery_names(self) -> List[str]:
+        # Names only — the flight recorder's digest must not trigger the
+        # full path reconstruction discoveries() performs.
+        return list(self._discoveries_fp)
+
+    def state_digest(self) -> dict:
+        digest = super().state_digest()
+        digest.update(
+            table_capacity=self._capacity,
+            frontier_capacity=self._F_max,
+            warmup_seconds=getattr(self, "warmup_seconds", None),
+            checkpoint_path=self._checkpoint_path,
+            last_dispatch=self._last_dispatch,
+        )
+        if self._tier is not None:
+            try:
+                digest["storage"] = self._tier.instruments.bench_stats()
+            except Exception:  # noqa: BLE001 - mid-crash best effort
+                digest["storage"] = None
+        return digest

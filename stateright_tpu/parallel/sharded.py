@@ -1185,6 +1185,7 @@ class ShardedTpuBfsChecker(Checker):
                     wave_new,
                     bucket=bucket,
                     compaction_ratio=(got / width if bucket else None),
+                    live_lanes=got,
                 )
             if self.warmup_seconds is None:
                 self.warmup_seconds = time.perf_counter() - self._t_start
@@ -1360,6 +1361,9 @@ class ShardedTpuBfsChecker(Checker):
                     count_wave=False,
                     observe=False,
                     waves=int(dstats[:, 4].max()),
+                    # Live pending states across all rings — the monitor's
+                    # progress fit reads this, not the capacity `frontier`.
+                    ring_count=int(dstats[:, 5].sum()),
                 )
             pool, head, count = res["pool"], res["head"], res["count"]
             ring_est = int(dstats[:, 5].max())
@@ -1381,16 +1385,22 @@ class ShardedTpuBfsChecker(Checker):
                             self._key_log.append(
                                 fp64_pairs(pack[d, 4, :ln], pack[d, 5, :ln])
                             )
-            table, pool, head, count, ring_est = self._consume_final(
-                res, dstats, table, pool, head, count, ring_est, depth_cap
-            )
+            with self._tracer.span("sharded_bfs.wave", drain=drains) as sp:
+                table, pool, head, count, ring_est = self._consume_final(
+                    res, dstats, table, pool, head, count, ring_est,
+                    depth_cap, span=sp,
+                )
 
     def _consume_final(
-        self, res, dstats, table, pool, head, count, ring_est, depth_cap
+        self, res, dstats, table, pool, head, count, ring_est, depth_cap,
+        span=None,
     ):
         """Applies the drain's final (unconsumed) wave host-side: counters,
         discoveries, parent-fp log, ring push of the exchanged rows, and
-        the table-growth overflow retry."""
+        the table-growth overflow retry. ``span`` (a wave span covering
+        this consume) gets the per-wave args the monitor reads — without
+        it the final wave's uniques are invisible to the progress
+        estimator and SSE stream (registry counters alone don't stream)."""
         props = self._properties
         n = self._n
         final = res["final"]
@@ -1452,6 +1462,7 @@ class ShardedTpuBfsChecker(Checker):
             ring_est += recv_per_dev
         # Overflow retry: grow the table and re-expand the saved frontier
         # through the wave path (fresh rows land in the host pool).
+        retry_new = 0
         if int(dstats[:, 8].sum()):
             fr = res["frontier"]
             while True:
@@ -1461,9 +1472,30 @@ class ShardedTpuBfsChecker(Checker):
                 # the wave path.
                 wave = self._call_wave(table, fr, depth_cap)
                 table = wave["table"]
-                self._wi.unique.inc(self._harvest(wave))
+                harvested = self._harvest(wave)
+                self._wi.unique.inc(harvested)
+                retry_new += harvested
                 if not int(self._pull(wave["overflow"]).sum()):
                     break
+        if span is not None:
+            gen = int(dstats[:, 7].sum())
+            nn = total_new + retry_new
+            span.set(
+                frontier=self._G,
+                generated=gen,
+                new_unique=nn,
+                # Clamped: the overflow retry's harvest rides nn but its
+                # regeneration is already inside gen — a skewed split must
+                # not stream an impossible negative rate.
+                dedup_hit_rate=(max(0.0, (gen - nn) / gen) if gen else 0.0),
+                occupancy=self._l0_count / (self._n * self._cap_loc),
+                max_depth=self._max_depth,
+                # The drain span already tallied this wave; live pending
+                # (ring residue after the push) rides for the monitor's
+                # frontier fit.
+                waves=0,
+                live_lanes=ring_est,
+            )
         return table, pool, head, count, ring_est
 
     def _checkpoint_rings(self, pool, head, count):
@@ -1771,12 +1803,16 @@ class ShardedTpuBfsChecker(Checker):
 
     def _record_wave_metrics(
         self, span, frontier, generated, n_new, bucket=None,
-        compaction_ratio=None,
+        compaction_ratio=None, live_lanes=None,
     ):
         """One host-visible wave's telemetry (the shared bundle does the
         recording; occupancy is the shard tables' resident load — under
         tiering the global unique count outgrows the devices)."""
         extra = {}
+        if live_lanes is not None:
+            # Live (pre-padding) pending rows: the monitor's frontier fit
+            # reads this over the dispatch-width `frontier` when present.
+            extra["live_lanes"] = live_lanes
         if self._si is not None:
             self._si.set_l0(self._l0_count)
             extra["storage_stale"] = self._wave_stale
@@ -1856,3 +1892,24 @@ class ShardedTpuBfsChecker(Checker):
 
     def worker_error(self) -> Optional[BaseException]:
         return self._error
+
+    def _discovery_names(self) -> List[str]:
+        # Names only — the flight recorder's digest must not trigger the
+        # full path reconstruction discoveries() performs.
+        return list(self._discoveries_fp)
+
+    def state_digest(self) -> dict:
+        digest = super().state_digest()
+        digest.update(
+            shards=self._n,
+            table_capacity_per_shard=getattr(self, "_cap_loc", None),
+            frontier_per_device=self._F_loc,
+            warmup_seconds=getattr(self, "warmup_seconds", None),
+            checkpoint_path=self._checkpoint_path,
+        )
+        if self._si is not None:
+            try:
+                digest["storage"] = self._si.bench_stats()
+            except Exception:  # noqa: BLE001 - mid-crash best effort
+                digest["storage"] = None
+        return digest

@@ -38,13 +38,42 @@ from .trace import (
     write_chrome_trace,
 )
 
+# The monitor server drags in http.server (and its email dependency) —
+# cost only monitored runs should pay, so its symbols resolve lazily
+# (PEP 562), matching the function-local imports in Checker.serve_monitor
+# and bench.py's --monitor-port path.
+_SERVER_SYMBOLS = frozenset({
+    "FlightRecorder",
+    "MonitorCore",
+    "MonitorServer",
+    "ProgressEstimator",
+    "StallWatchdog",
+    "prometheus_text",
+})
+
+
+def __getattr__(name):
+    if name in _SERVER_SYMBOLS:
+        from . import server
+
+        return getattr(server, name)
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}"
+    )
+
+
 __all__ = [
     "BlockInstruments",
     "Counter",
+    "FlightRecorder",
     "Gauge",
     "Histogram",
     "JsonlSink",
     "MetricsRegistry",
+    "MonitorCore",
+    "MonitorServer",
+    "ProgressEstimator",
+    "StallWatchdog",
     "Tracer",
     "WaveInstruments",
     "chrome_trace",
@@ -54,6 +83,7 @@ __all__ = [
     "get_tracer",
     "instant",
     "metrics_registry",
+    "prometheus_text",
     "span",
     "write_chrome_trace",
 ]

@@ -118,18 +118,22 @@ class BlockInstruments:
 
     def record(
         self, span, *, evaluated: int, generated: int, max_depth: int,
-        unique_total: int,
+        unique_total: int, pending: int = None,
     ) -> None:
         """Closes out one block: registry updates + the block span's
         late-bound args (the span is entered by the caller around the
-        block body and exited here)."""
+        block body and exited here). ``pending`` is the worker's live
+        outstanding-work count — the monitor's frontier fit reads it
+        (``evaluated`` is a block-width constant, useless for ETA)."""
         self.blocks.inc()
         self.evaluated.inc(evaluated)
         self.generated.inc(generated)
         self.block_width.observe(evaluated)
+        extra = {} if pending is None else {"pending": pending}
         span.set(
             evaluated=evaluated,
             generated=generated,
             max_depth=max_depth,
             unique_total=unique_total,
+            **extra,
         ).__exit__(None, None, None)

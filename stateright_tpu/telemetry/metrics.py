@@ -160,6 +160,13 @@ class MetricsRegistry:
             items = sorted(self._instruments.items())
         return {name: inst.snapshot() for name, inst in items}
 
+    def instruments(self) -> List:
+        """Sorted ``(name, instrument)`` pairs — the typed view exporters
+        need (``snapshot`` erases the counter/gauge distinction, which a
+        Prometheus exposition cannot afford to lose)."""
+        with self._lock:
+            return sorted(self._instruments.items())
+
     def reset(self) -> None:
         """Drops every instrument (tests and run isolation)."""
         with self._lock:

@@ -26,6 +26,7 @@ import json
 import os
 import threading
 import time
+import weakref
 from collections import deque
 from typing import Dict, IO, Iterable, List, Optional
 
@@ -36,9 +37,25 @@ def _now_us() -> float:
     return time.perf_counter_ns() / 1000.0
 
 
+def _flush_close(file, owns, lock):
+    with lock:
+        try:
+            file.flush()
+        except ValueError:
+            pass  # already closed (idempotent close / atexit replay)
+        if owns:
+            file.close()
+
+
 class JsonlSink:
     """Appends each event as one JSON line; thread-safe, flushed per
-    write so a killed run still leaves a parseable prefix."""
+    write so a killed run still leaves a parseable prefix. ``close()``
+    always flushes (even for caller-owned files) and every sink carries
+    a ``weakref.finalize`` — it fires at interpreter exit so a short run
+    that never detaches its sink still lands its tail events on disk,
+    but unlike ``atexit.register(self.close)`` it does not pin the sink
+    (and its fd) for the whole process lifetime: a long-lived service
+    that churns through sinks gets each one flushed and released at GC."""
 
     def __init__(self, path_or_file):
         if hasattr(path_or_file, "write"):
@@ -50,6 +67,9 @@ class JsonlSink:
             self._owns = True
             self.path = os.fspath(path_or_file)
         self._lock = threading.Lock()
+        self._finalizer = weakref.finalize(
+            self, _flush_close, self._file, self._owns, self._lock
+        )
 
     def write_event(self, event: Dict) -> None:
         line = json.dumps(event, separators=(",", ":"))
@@ -65,9 +85,7 @@ class JsonlSink:
             pass
 
     def close(self) -> None:
-        if self._owns:
-            with self._lock:
-                self._file.close()
+        self._finalizer()  # at most once; later calls are no-ops
 
 
 class _Span:
@@ -181,8 +199,24 @@ class Tracer:
             sink.close()
 
     def events(self) -> List[Dict]:
-        """The ring buffer's current contents, oldest first."""
-        return list(self._ring)
+        """The ring buffer's current contents, oldest first. A worker
+        thread appending mid-copy raises RuntimeError from deque
+        iteration (the flight recorder's SIGTERM dump races live wave
+        emission); retry — the ring is bounded, so each attempt is
+        fast — then fall back to a per-index best-effort copy rather
+        than losing the final-wave forensics entirely."""
+        for _ in range(8):
+            try:
+                return list(self._ring)
+            except RuntimeError:
+                continue
+        out: List[Dict] = []
+        for i in range(len(self._ring)):
+            try:
+                out.append(self._ring[i])
+            except IndexError:
+                break
+        return out
 
     def clear(self) -> None:
         self._ring.clear()

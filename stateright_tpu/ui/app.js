@@ -147,6 +147,139 @@ $("reset").addEventListener("click", () => {
   refreshSteps();
 });
 
+// ---- live monitor panel ---------------------------------------------------
+// Fed by the monitor endpoints the server mounts next to the Explorer API:
+// /events (SSE wave/storage stream) drives the states/s sparkline, /status
+// (JSON snapshot) fills depth, hash-set fill, tier bytes, and the ETA band.
+// The panel stays hidden when the endpoints are absent (plain static serve).
+
+const monitor = { points: [], max: 120, lastStatusFetch: 0, backend: null };
+
+function fmtNum(n) {
+  if (n === null || n === undefined) return "–";
+  if (n >= 1e6) return (n / 1e6).toFixed(1) + "M";
+  if (n >= 1e3) return (n / 1e3).toFixed(1) + "k";
+  return Number(n).toFixed(n >= 10 ? 0 : 1);
+}
+
+function fmtSecs(s) {
+  if (s === null || s === undefined) return "–";
+  if (s < 90) return s.toFixed(0) + "s";
+  if (s < 5400) return (s / 60).toFixed(1) + "m";
+  return (s / 3600).toFixed(1) + "h";
+}
+
+function drawSparkline() {
+  const canvas = $("monitor-sparkline");
+  const ctx = canvas.getContext("2d");
+  ctx.clearRect(0, 0, canvas.width, canvas.height);
+  const pts = monitor.points;
+  if (pts.length < 2) return;
+  const peak = Math.max(...pts, 1e-9);
+  ctx.beginPath();
+  // Scale x to the points present (short runs fill the canvas); only a
+  // full buffer scrolls at the fixed window width.
+  const span = Math.max(pts.length - 1, 1);
+  pts.forEach((v, i) => {
+    const x = (i / span) * canvas.width;
+    const y = canvas.height - 2 - (v / peak) * (canvas.height - 6);
+    i === 0 ? ctx.moveTo(x, y) : ctx.lineTo(x, y);
+  });
+  ctx.strokeStyle = "#10394c";
+  ctx.lineWidth = 1.5;
+  ctx.stroke();
+}
+
+function onWaveEvent(d) {
+  // Remember the live backend's span-name prefix ("tpu_bfs.drain" ->
+  // "tpu_bfs"): the metrics registry is process-global, so a finished
+  // earlier run's gauges must not shadow this run's in /status picks.
+  if (d.name) monitor.backend = d.name.split(".")[0];
+  if (d.ewma_states_per_s !== null && d.ewma_states_per_s !== undefined) {
+    monitor.points.push(d.ewma_states_per_s);
+    if (monitor.points.length > monitor.max) monitor.points.shift();
+    $("mon-rate").textContent = fmtNum(d.ewma_states_per_s);
+  }
+  if (d.max_depth !== null && d.max_depth !== undefined)
+    $("mon-depth").textContent = d.max_depth;
+  if (d.occupancy !== null && d.occupancy !== undefined)
+    $("mon-fill").textContent = (100 * d.occupancy).toFixed(1) + "%";
+  if (d.eta_s_low !== null && d.eta_s_low !== undefined)
+    $("mon-eta").textContent =
+      fmtSecs(d.eta_s_low) + "–" + fmtSecs(d.eta_s_high);
+  drawSparkline();
+}
+
+async function refreshMonitorStatus() {
+  // Throttled: storage events can arrive several times per wave during
+  // heavy spilling, and each full /status fetch is not free.
+  const now = Date.now();
+  if (now - monitor.lastStatusFetch < 1500) return;
+  monitor.lastStatusFetch = now;
+  try {
+    const s = await getJSON("/status");
+    const m = s.metrics || {};
+    const pick = (suffix) => {
+      // Prefer the backend the SSE stream says is live; fall back to any
+      // suffix match (single-backend processes, pre-first-wave polls).
+      let fallback = null;
+      for (const k of Object.keys(m)) {
+        if (!k.endsWith(suffix)) continue;
+        if (monitor.backend && k.startsWith(monitor.backend + "."))
+          return m[k];
+        if (fallback === null) fallback = m[k];
+      }
+      return fallback;
+    };
+    const occ = pick(".hashset_occupancy");
+    if (occ !== null) $("mon-fill").textContent = (100 * occ).toFixed(1) + "%";
+    const l0 = pick(".storage.l0_resident");
+    if (l0 !== null) $("mon-l0").textContent = fmtNum(l0) + " fps";
+    const hostB = pick(".storage.host_bytes");
+    const diskB = pick(".storage.disk_bytes");
+    if (hostB !== null || diskB !== null)
+      $("mon-tiers").textContent =
+        fmtNum(hostB || 0) + "B / " + fmtNum(diskB || 0) + "B";
+    const p = s.progress || {};
+    if (p.max_depth !== null && p.max_depth !== undefined)
+      $("mon-depth").textContent = p.max_depth;
+    if (p.eta_s_low !== null && p.eta_s_low !== undefined)
+      $("mon-eta").textContent =
+        fmtSecs(p.eta_s_low) + "–" + fmtSecs(p.eta_s_high);
+  } catch (err) {
+    // monitor endpoints absent or mid-teardown; leave the panel as-is
+  }
+}
+
+function startMonitor() {
+  let es;
+  try {
+    es = new EventSource("/events");
+  } catch (err) {
+    return;
+  }
+  let everConnected = false;
+  es.addEventListener("hello", () => {
+    $("monitor-panel").classList.remove("hidden");
+    if (!everConnected) {
+      everConnected = true;
+      // Status polling only once the endpoints are known to exist —
+      // a static serve must not 404-poll forever for a hidden panel.
+      setInterval(refreshMonitorStatus, 2000);
+    }
+  });
+  es.addEventListener("wave", (e) => onWaveEvent(JSON.parse(e.data)));
+  es.addEventListener("storage", () => refreshMonitorStatus());
+  es.onerror = () => {
+    // Never connected => no monitor endpoints on this server: close for
+    // good, panel stays hidden. Once live, errors are transient drops —
+    // leave the EventSource alone so its auto-reconnect resumes the
+    // stream (the long-run case the panel exists for).
+    if (!everConnected) es.close();
+  };
+}
+
 refreshSteps();
 refreshStatus();
 setInterval(refreshStatus, 1000);
+startMonitor();

@@ -1,0 +1,601 @@
+"""Live-monitor tests: Prometheus exposition golden, progress/ETA
+estimation, SSE smoke, stall watchdog (fake clock), flight-recorder
+round trips (in-process exception and subprocess SIGTERM), Explorer
+integration, golden reporter strings with the monitor attached, and the
+monitor-on overhead budget. All CPU-only, tier-1 fast."""
+
+import io
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from fixtures import LinearEquation
+from stateright_tpu import WriteReporter
+from stateright_tpu.telemetry import (
+    FlightRecorder,
+    MetricsRegistry,
+    MonitorServer,
+    ProgressEstimator,
+    StallWatchdog,
+    Tracer,
+    get_tracer,
+    metrics_registry,
+    prometheus_text,
+)
+from stateright_tpu.telemetry.server import MonitorCore, sanitize_metric_name
+
+REPO_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FLIGHT_REPORT = os.path.join(REPO_DIR, "scripts", "flight_report.py")
+TRACE_SUMMARY = os.path.join(REPO_DIR, "scripts", "trace_summary.py")
+
+
+def _get(url, timeout=10):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.status, r.read()
+
+
+def _get_json(url, timeout=10):
+    code, body = _get(url, timeout=timeout)
+    return code, json.loads(body)
+
+
+# -- Prometheus exposition --------------------------------------------------
+
+
+def test_metrics_exposition_golden():
+    """The /metrics text format is a compatibility surface: sanitized
+    names, counters suffixed _total, unset gauges elided, log2
+    histograms as cumulative le-buckets."""
+    reg = MetricsRegistry()
+    reg.counter("tpu_bfs.waves").inc(3)
+    reg.counter("tpu_bfs.bucket_dispatch.1024").inc()
+    reg.gauge("tpu_bfs.hashset_occupancy").set(0.41)
+    reg.gauge("tpu_bfs.storage.host_bytes").set(4096)
+    reg.gauge("never.set")  # no sample => elided
+    h = reg.histogram("bfs.block_states")
+    h.observe(1)
+    h.observe(3)
+    h.observe(4)
+    assert prometheus_text(reg) == (
+        "# TYPE stateright_bfs_block_states histogram\n"
+        'stateright_bfs_block_states_bucket{le="1.0"} 1\n'
+        'stateright_bfs_block_states_bucket{le="4.0"} 3\n'
+        'stateright_bfs_block_states_bucket{le="+Inf"} 3\n'
+        "stateright_bfs_block_states_sum 8\n"
+        "stateright_bfs_block_states_count 3\n"
+        "# TYPE stateright_tpu_bfs_bucket_dispatch_1024_total counter\n"
+        "stateright_tpu_bfs_bucket_dispatch_1024_total 1\n"
+        "# TYPE stateright_tpu_bfs_hashset_occupancy gauge\n"
+        "stateright_tpu_bfs_hashset_occupancy 0.41\n"
+        "# TYPE stateright_tpu_bfs_storage_host_bytes gauge\n"
+        "stateright_tpu_bfs_storage_host_bytes 4096\n"
+        "# TYPE stateright_tpu_bfs_waves_total counter\n"
+        "stateright_tpu_bfs_waves_total 3\n"
+    )
+
+
+def test_metric_name_sanitization():
+    assert sanitize_metric_name("a.b-c d") == "stateright_a_b_c_d"
+    assert sanitize_metric_name("x", prefix="") == "x"
+    assert sanitize_metric_name("9x", prefix="") == "_9x"
+
+
+# -- progress / ETA estimator ----------------------------------------------
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_estimator_eta_band_nonnull_after_three_waves():
+    clock = FakeClock()
+    est = ProgressEstimator(clock=clock)
+    # Decaying frontier: growth < 1, ETA converges.
+    for frontier in (1000, 500, 250, 125):
+        est.observe(n_new=frontier, generated=frontier * 3,
+                    frontier=frontier, depth=1)
+        clock.t += 1.0
+    snap = est.snapshot()
+    assert snap["waves"] == 4
+    assert snap["ewma_states_per_s"] > 0
+    assert 0.4 < snap["frontier_growth"] < 0.6
+    assert snap["eta_s_low"] is not None
+    assert snap["eta_s_high"] is not None
+    assert snap["eta_s_low"] <= snap["eta_s_high"]
+    # Decaying at g=0.5 from 125: ~125 remaining beyond the frontier.
+    assert snap["eta_s_high"] < 10.0
+    assert snap["dedup_hit_rate"] == pytest.approx(2 / 3)
+
+
+def test_estimator_growing_frontier_band_is_finite_and_ordered():
+    clock = FakeClock()
+    est = ProgressEstimator(clock=clock)
+    for frontier in (10, 20, 40, 80):
+        est.observe(n_new=frontier, generated=frontier, frontier=frontier)
+        clock.t += 1.0
+    low, high = est.eta_band()
+    assert low is not None and high is not None and low <= high
+    assert est.frontier_growth() > 1.5
+
+
+def test_estimator_null_before_min_waves():
+    est = ProgressEstimator(clock=FakeClock())
+    est.observe(n_new=5, generated=10, frontier=5)
+    assert est.eta_band() == (None, None)
+
+
+# -- stall watchdog (fake clock, no threads) --------------------------------
+
+
+def test_stall_watchdog_fires_once_and_rearms(capsys):
+    clock = FakeClock()
+    reg = MetricsRegistry()
+    tracer = Tracer()
+    stalls = []
+    dog = StallWatchdog(
+        deadline_s=10.0, registry=reg, tracer=tracer, clock=clock,
+        on_stall=stalls.append,
+    )
+    assert not dog.poll()  # fresh: inside the deadline
+    clock.t += 9.0
+    assert not dog.poll()
+    clock.t += 2.0  # 11s since pet: stall
+    assert dog.poll()
+    assert not dog.poll()  # fires once per stall
+    assert stalls and stalls[0] > 10.0
+    assert reg.counter("monitor.stalls").snapshot() == 1
+    instants = [e for e in tracer.events() if e["name"] == "monitor.stall"]
+    assert len(instants) == 1
+    assert instants[0]["args"]["deadline_s"] == 10.0
+    assert "monitor.stall" in capsys.readouterr().err
+    # A wave re-arms; the next overrun fires again.
+    dog.pet()
+    clock.t += 11.0
+    assert dog.poll()
+    assert reg.counter("monitor.stalls").snapshot() == 2
+
+
+def test_stall_watchdog_disarms_when_checker_done():
+    """Waves stopping because the check FINISHED is not a stall: a
+    monitor held open past completion must stay silent."""
+    clock = FakeClock()
+    reg = MetricsRegistry()
+    done = [True]
+    dog = StallWatchdog(
+        deadline_s=10.0, registry=reg, tracer=Tracer(), clock=clock,
+        done_fn=lambda: done[0],
+    )
+    clock.t += 11.0
+    assert not dog.poll()
+    assert reg.counter("monitor.stalls").snapshot() == 0
+    # Still-running checker overrunning the deadline fires as usual.
+    done[0] = False
+    assert dog.poll()
+    assert reg.counter("monitor.stalls").snapshot() == 1
+
+
+def test_monitor_core_counts_explicit_zero_waves():
+    """A drain span's ``waves=0`` (final wave rides the following wave
+    span) must count zero — only a MISSING arg defaults to 1."""
+    core = MonitorCore(registry=MetricsRegistry(), tracer=Tracer())
+    span = {"ph": "X", "name": "tpu_bfs.drain", "dur": 1000.0,
+            "args": {"new_unique": 5, "generated": 10, "frontier": 8,
+                     "waves": 0}}
+    core.write_event(dict(span, args=dict(span["args"])))
+    assert core.estimator.waves == 0
+    core.write_event(dict(span, args=dict(span["args"], waves=3)))
+    assert core.estimator.waves == 3
+    no_waves = dict(span["args"])
+    del no_waves["waves"]
+    core.write_event(dict(span, args=no_waves))
+    assert core.estimator.waves == 4
+
+
+def test_monitor_prefers_live_ring_count_over_capacity_frontier():
+    """Deep-drain spans carry the dispatch CAPACITY as ``frontier``
+    (constant F_max all run) and the live pending count as
+    ``ring_count`` — the progress fit must read the live value, or the
+    growth factor and ETA band are capacity-derived constants in the
+    default (deep-drain) mode."""
+    core = MonitorCore(registry=MetricsRegistry(), tracer=Tracer())
+    for ring in (1000, 500, 250, 125):
+        core.write_event({
+            "ph": "X", "name": "tpu_bfs.drain", "dur": 1000.0,
+            "args": {"new_unique": ring, "generated": ring * 3,
+                     "frontier": 4096, "ring_count": ring, "waves": 1},
+        })
+    snap = core.estimator.snapshot()
+    assert snap["frontier"] == 125  # live, not the 4096 capacity
+    assert snap["frontier_growth"] < 0.6  # decaying, not flat ~1.0
+    # Consume-wave spans carry the live value as `live_lanes` instead.
+    core.write_event({
+        "ph": "X", "name": "tpu_bfs.wave", "dur": 1000.0,
+        "args": {"new_unique": 60, "generated": 180, "frontier": 4096,
+                 "live_lanes": 60},
+    })
+    assert core.estimator.snapshot()["frontier"] == 60
+
+
+# -- flight recorder --------------------------------------------------------
+
+
+def test_flight_dump_on_exception_round_trip(tmp_path):
+    """dump -> scripts/flight_report.py parses and renders."""
+    checker = LinearEquation(2, 10, 14).checker().spawn_bfs().join()
+    rec = FlightRecorder(
+        run_id="testrun", out_dir=str(tmp_path), checker=checker
+    )
+    try:
+        raise ValueError("boom at wave 7")
+    except ValueError:
+        path = rec.dump("exception", exc=sys.exc_info())
+    assert path == str(tmp_path / "flight-testrun.json")
+    with open(path) as f:
+        record = json.load(f)
+    assert record["flight_recorder"] == 1
+    assert record["reason"] == "exception"
+    assert record["exception"]["type"] == "ValueError"
+    assert "boom at wave 7" in record["exception"]["traceback"]
+    assert record["digest"]["backend"] == "BfsChecker"
+    assert record["digest"]["unique_state_count"] == 12
+    assert record["digest"]["discoveries"] == ["solvable"]
+    assert isinstance(record["ring"], list)
+    assert isinstance(record["metrics"], dict)
+
+    r = subprocess.run(
+        [sys.executable, FLIGHT_REPORT, path],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert r.returncode == 0, r.stderr
+    assert "ValueError: boom at wave 7" in r.stdout
+    assert "BfsChecker" in r.stdout
+
+
+def test_flight_excepthook_chains(tmp_path):
+    rec = FlightRecorder(run_id="hook", out_dir=str(tmp_path))
+    seen = []
+    prev, sys.excepthook = sys.excepthook, lambda *a: seen.append(a)
+    try:
+        rec.install()
+        try:
+            raise RuntimeError("unhandled")
+        except RuntimeError:
+            sys.excepthook(*sys.exc_info())
+    finally:
+        rec.uninstall()
+        sys.excepthook = prev
+    assert seen, "previous excepthook must still run"
+    with open(tmp_path / "flight-hook.json") as f:
+        assert json.load(f)["exception"]["type"] == "RuntimeError"
+
+
+_SIGTERM_CHILD = """
+import sys
+sys.path.insert(0, {repo!r})
+from stateright_tpu import Model, Property
+from stateright_tpu.telemetry import MonitorServer
+
+class Endless(Model):
+    # Unbounded counter chain: the BFS never finishes, so the parent's
+    # SIGTERM always lands mid-run (deterministically "mid-wave").
+    def init_states(self):
+        return [0]
+
+    def actions(self, state, actions):
+        actions.append("inc")
+
+    def next_state(self, state, action):
+        return state + 1
+
+    def properties(self):
+        return [Property.always("ok", lambda m, s: True)]
+
+mon = MonitorServer(
+    port=0, run_id="sigterm", flight_recorder=True, flight_dir={out!r}
+)
+checker = Endless().checker().spawn_bfs()
+mon.attach(checker)
+print("READY", mon.port, flush=True)
+checker.join()
+"""
+
+
+def test_sigterm_produces_parseable_flight_file(tmp_path):
+    """Killing a monitored run mid-run dumps flight-<run_id>.json whose
+    ring buffer holds the final wave/block spans, and flight_report.py
+    renders it."""
+    child = subprocess.Popen(
+        [sys.executable, "-c",
+         _SIGTERM_CHILD.format(repo=REPO_DIR, out=str(tmp_path))],
+        stdout=subprocess.PIPE, text=True,
+    )
+    try:
+        line = child.stdout.readline()
+        assert line.startswith("READY"), line
+        time.sleep(1.0)  # let blocks flow so the ring has spans
+        child.send_signal(signal.SIGTERM)
+        rc = child.wait(timeout=30)
+    finally:
+        if child.poll() is None:
+            child.kill()
+            child.wait(timeout=30)
+    # The recorder re-delivers the signal: exit reflects SIGTERM death.
+    assert rc != 0
+    path = tmp_path / "flight-sigterm.json"
+    assert path.exists(), "SIGTERM must leave a flight dump"
+    with open(path) as f:
+        record = json.load(f)
+    assert record["reason"] == "SIGTERM"
+    assert record["digest"]["backend"] == "BfsChecker"
+    assert record["digest"]["done"] is False
+    spans = [e for e in record["ring"]
+             if e.get("ph") == "X" and "unique_total" in (e.get("args") or {})]
+    assert spans, "ring buffer must carry the final block spans"
+    r = subprocess.run(
+        [sys.executable, FLIGHT_REPORT, str(path)],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert r.returncode == 0, r.stderr
+    assert "SIGTERM" in r.stdout
+
+
+# -- monitor server: /metrics, /status, /events -----------------------------
+
+
+@pytest.fixture
+def monitor():
+    mon = MonitorServer(port=0)
+    yield mon
+    mon.close()
+
+
+def test_status_and_metrics_concurrent_with_checking(monitor):
+    checker = LinearEquation(2, 10, 14).checker().spawn_bfs()
+    monitor.attach(checker)
+    checker.join()
+    code, status = _get_json(monitor.url + "/status")
+    assert code == 200
+    assert status["checker"]["backend"] == "BfsChecker"
+    assert status["checker"]["unique_state_count"] == 12
+    progress = status["progress"]
+    assert progress["unique_states"] >= 1
+    assert "eta_s_low" in progress and "eta_s_high" in progress
+    assert isinstance(status["metrics"], dict)
+    code, body = _get(monitor.url + "/metrics")
+    assert code == 200
+    text = body.decode()
+    assert "stateright_bfs_blocks_total" in text
+    assert "# TYPE" in text
+    code, index = _get_json(monitor.url + "/")
+    assert code == 200
+    assert set(index["endpoints"]) == {"/metrics", "/status", "/events"}
+
+
+def test_sse_stream_delivers_wave_events(monitor):
+    """Connect, receive >= 1 wave event, disconnect."""
+    frames = []
+    connected = threading.Event()
+
+    def reader():
+        req = urllib.request.urlopen(monitor.url + "/events", timeout=15)
+        try:
+            buf = b""
+            connected.set()
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                # SSE is line-oriented; readline never blocks past the
+                # next flushed event (a fixed-size read would).
+                line = req.readline()
+                if not line:
+                    break
+                buf += line
+                at = buf.find(b"event: wave")
+                if at != -1 and buf.find(b"\n\n", at) != -1:
+                    # Full frame (event line + data line) received.
+                    frames.append(buf)
+                    break
+        finally:
+            req.close()
+
+    t = threading.Thread(target=reader, daemon=True)
+    t.start()
+    assert connected.wait(timeout=10)
+    checker = LinearEquation(2, 10, 14).checker().spawn_bfs()
+    monitor.attach(checker)
+    checker.join()
+    t.join(timeout=15)
+    assert frames, "SSE client must receive at least one wave event"
+    text = frames[0].decode()
+    assert "event: hello" in text  # stream liveness marker
+    data = next(
+        line for line in text.splitlines()
+        if line.startswith("data:") and '"new_unique"' in line
+    )
+    payload = json.loads(data[len("data:"):])
+    assert payload["new_unique"] >= 0
+    assert "ewma_states_per_s" in payload
+    # Disconnected reader must be dropped from the broker. The handler
+    # notices on its next write, so nudge one event through.
+    deadline = time.time() + 10
+    while monitor.core.broker.client_count() and time.time() < deadline:
+        monitor.core.broker.publish("wave", {"nudge": 1})
+        time.sleep(0.05)
+    assert monitor.core.broker.client_count() == 0
+
+
+def test_device_checker_eta_nonnull_after_three_waves(monitor):
+    """The acceptance shape: a device-backend run with the monitor
+    attached serves /status with non-null ETA fields after >= 3 waves."""
+    from stateright_tpu.models.two_phase_commit import TwoPhaseSys
+
+    checker = (
+        TwoPhaseSys(2)
+        .checker()
+        .spawn_tpu_bfs(
+            frontier_capacity=1 << 6, table_capacity=1 << 10,
+            max_drain_waves=1,  # wave-at-a-time: one event per wave
+        )
+    )
+    monitor.attach(checker)
+    checker.join()
+    assert checker.unique_state_count() == 56
+    code, status = _get_json(monitor.url + "/status")
+    assert code == 200
+    progress = status["progress"]
+    assert progress["waves"] >= 3
+    assert progress["eta_s_low"] is not None
+    assert progress["eta_s_high"] is not None
+    assert progress["ewma_states_per_s"] > 0
+    # The ETA band also publishes as gauges (Prometheus surface).
+    snap = metrics_registry().snapshot()
+    assert snap["monitor.eta_low_seconds"] is not None
+    assert snap["monitor.states_per_second_ewma"] > 0
+    digest = checker.state_digest()
+    assert digest["table_capacity"] >= 1 << 10  # may have grown mid-run
+    assert digest["frontier_capacity"] == 1 << 6
+
+
+def test_golden_reporter_strings_unchanged_with_monitor_attached(monitor):
+    """The WriteReporter compatibility strings must stay byte-identical
+    while the monitor consumes every span the run emits."""
+    checker = LinearEquation(2, 10, 14).checker().spawn_bfs()
+    monitor.attach(checker)
+    checker.join()
+    out = io.StringIO()
+    checker.report(WriteReporter(out))
+    assert out.getvalue().startswith(
+        "Done. states=15, unique=12, depth=4, sec="
+    )
+    assert monitor.core.estimator.waves >= 1  # the monitor really saw it
+
+
+def test_explorer_serves_monitor_endpoints():
+    from stateright_tpu.checker.explorer import start_server
+    from stateright_tpu.models.two_phase_commit import TwoPhaseSys
+
+    server, checker = start_server(
+        TwoPhaseSys(3).checker(), ("localhost", 0)
+    )
+    try:
+        host, port = server.server_address[:2]
+        base = f"http://{host}:{port}"
+        checker.run_to_completion()
+        checker.join()
+        code, status = _get_json(base + "/.status")
+        assert code == 200
+        # The on-demand checker's /.status carries the same progress
+        # fields as the monitor /status.
+        progress = status["progress"]
+        assert progress is not None
+        assert progress["unique_states"] >= 288
+        assert {"eta_s_low", "eta_s_high", "ewma_states_per_s"} <= set(
+            progress
+        )
+        code, body = _get(base + "/metrics")
+        assert code == 200
+        assert b"stateright_on_demand_blocks_total" in body
+        code, mstatus = _get_json(base + "/status")
+        assert code == 200
+        assert mstatus["checker"]["backend"] == "OnDemandChecker"
+    finally:
+        server.shutdown()
+
+
+# -- monitor-on overhead budget --------------------------------------------
+
+
+def test_monitor_on_overhead_under_budget():
+    """Monitor-on vs monitor-off must cost <5% on a checker run. Same
+    form as PR 3's always-on budget test: the per-event sink cost
+    (estimator + gauges + zero-client broker fanout) times the events a
+    real run emits, measured against that run's wall time — direct A/B
+    of sub-second runs on this shared box swings far more than the 5%
+    being asserted, while per-event cost over 10k iterations is stable."""
+    reg = metrics_registry()
+    blocks_before = reg.counter("bfs.blocks").snapshot()
+    t0 = time.perf_counter()
+    LinearEquation(2, 4, 7).checker().spawn_bfs().join()
+    run_secs = time.perf_counter() - t0
+    events = reg.counter("bfs.blocks").snapshot() - blocks_before
+    assert events >= 1
+
+    mon = MonitorServer(port=0)
+    try:
+        ev = {
+            "name": "tpu_bfs.wave", "ph": "X", "ts": 0.0, "dur": 1000.0,
+            "pid": 1, "tid": 1,
+            "args": {
+                "frontier": 512, "generated": 4096, "new_unique": 1024,
+                "dedup_hit_rate": 0.75, "occupancy": 0.3, "max_depth": 9,
+            },
+        }
+        n = 10_000
+        t0 = time.perf_counter()
+        for _ in range(n):
+            mon.core.write_event(ev)
+        per_event = (time.perf_counter() - t0) / n
+    finally:
+        mon.close()
+
+    overhead = per_event * events
+    assert overhead < 0.05 * run_secs, (
+        f"monitor overhead too high: {events} events x "
+        f"{per_event * 1e6:.1f}us = {overhead * 1e3:.2f}ms on a "
+        f"{run_secs * 1e3:.0f}ms run"
+    )
+
+
+# -- trace_summary hardening + JsonlSink tail durability --------------------
+
+
+def test_trace_summary_counts_torn_lines_and_tops(tmp_path):
+    path = tmp_path / "torn.jsonl"
+    events = [
+        {"name": "tpu_bfs.wave", "ph": "X", "ts": 1.0, "dur": 5000.0,
+         "args": {"frontier": 4, "generated": 8, "new_unique": 4,
+                  "dedup_hit_rate": 0.5, "occupancy": 0.1,
+                  "max_depth": 2}},
+        {"name": "tpu_bfs.table_grow", "ph": "X", "ts": 2.0,
+         "dur": 9000.0, "args": {"from_capacity": 8}},
+    ]
+    with open(path, "w") as f:
+        for ev in events:
+            f.write(json.dumps(ev) + "\n")
+        f.write('{"name": "torn", "ph": "X", "ts": 3')  # killed mid-write
+    r = subprocess.run(
+        [sys.executable, TRACE_SUMMARY, str(path), "--top", "2"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert r.returncode == 0, r.stderr
+    assert "skipped 1 unparseable line(s)" in r.stderr
+    assert "tpu_bfs.wave" in r.stdout
+    # --top lists the slowest spans of ANY kind, slowest first (its
+    # header is the LAST "span" column header in the output).
+    top = r.stdout[r.stdout.rindex("span"):]
+    assert top.index("table_grow") < top.index("tpu_bfs.wave")
+
+
+def test_jsonl_sink_close_flushes_and_is_idempotent(tmp_path):
+    from stateright_tpu.telemetry import JsonlSink
+
+    path = tmp_path / "tail.jsonl"
+    f = open(path, "w", buffering=1 << 20)  # big buffer: no auto-flush
+    sink = JsonlSink(f)
+    # Bypass write_event's per-write flush to prove close() flushes.
+    f.write('{"name": "tail-event"}\n')
+    assert path.read_text() == ""  # still buffered
+    sink.close()
+    assert "tail-event" in path.read_text()
+    sink.close()  # idempotent: atexit may replay it
+    f.close()
