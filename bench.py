@@ -306,6 +306,13 @@ def _run_leg(leg: str, pin_cpu: bool):
                 RUNTIME_DIR, f"spill_{leg}"
             )
         out["hbm_budget_mib"] = budget
+    # Wave-timeline attribution (--attribution): fences each wave and
+    # classifies wall into phases (telemetry/attribution.py). Opt-in:
+    # the fences serialize dispatch, so the timed rate measures the
+    # attributed regime — the per-leg record says so.
+    if "--attribution" in sys.argv:
+        spec["spawn"]["attribution"] = True
+        out["attribution_enabled"] = True
     if spec.get("host_baseline") and "--no-host-baseline" not in sys.argv:
         t0 = time.time()
         host = (
@@ -444,6 +451,12 @@ def _run_leg(leg: str, pin_cpu: bool):
     if spec.get("advisory"):
         # Sub-second steady windows are not rate claims (VERDICT r04 #6).
         out["advisory"] = True
+    # expand_fps as a measured policy: one calibration wave per pipeline
+    # AFTER the timed run (its jits must not pollute the leg timing) but
+    # BEFORE the telemetry snapshot (the mismatch counter rides it).
+    # --no-calibrate skips it.
+    if "--no-calibrate" not in sys.argv:
+        out["pipeline_choice"] = _calibrate_pipeline(leg, spec, checker)
     # Leg-level observability: the wave/occupancy counters the run left in
     # the registry (scalar instruments only — histograms ride the trace).
     snap = checker.metrics().snapshot()
@@ -467,6 +480,11 @@ def _run_leg(leg: str, pin_cpu: bool):
     tier = getattr(checker, "_tier", None)
     if tier is not None:
         out["storage"] = tier.instruments.bench_stats()
+    # Attribution record: the phase ledger + overlap headroom (the
+    # go/no-go number for the async pipelined wave engine).
+    attribution = checker.attribution_report()
+    if attribution is not None:
+        out["attribution"] = attribution
     want = spec.get("expect_discovery")
     if want is not None:
         path = checker.discoveries().get(want)
@@ -483,6 +501,74 @@ def _run_leg(leg: str, pin_cpu: bool):
         + (f"; ttc={out['ttc_s']:.2f}s" if "ttc_s" in out else "")
     )
     print(json.dumps(out))
+
+
+# Configured-vs-measured pipeline mismatch threshold: the configured
+# pipeline must be >10% slower than the measured winner before the bench
+# flags it — sub-10% deltas on this shared box are noise, while the
+# regressions that motivated the policy (VERDICT r05: abd3o 2.5x, scr4
+# 26%) clear it comfortably.
+PIPELINE_MISMATCH_FACTOR = 1.10
+
+
+def evaluate_pipeline_choice(
+    configured, fps_ms, materialize_ms, factor=PIPELINE_MISMATCH_FACTOR
+):
+    """True when the CONFIGURED expansion pipeline measured more than
+    ``factor``× slower than the other one — the silent-regression
+    condition the calibration wave exists to surface. Pure so the gate
+    is unit-testable without a jax run."""
+    if configured not in ("fps", "materialize"):
+        return False
+    if not fps_ms or not materialize_ms:
+        return False
+    mine = fps_ms if configured == "fps" else materialize_ms
+    other = materialize_ms if configured == "fps" else fps_ms
+    return mine > factor * other
+
+
+def _calibrate_pipeline(leg, spec, checker):
+    """Times one calibration wave per expansion pipeline for this leg's
+    model (breakdown.measure_pipeline_choice), records the configured
+    pipeline next to both timings, and warns (stderr +
+    ``bench.pipeline_mismatch`` counter — it rides the leg's telemetry
+    snapshot) when the configured one is measurably slower. Never fatal:
+    a failed calibration returns its error instead of killing the leg."""
+    configured = getattr(checker, "pipeline", None)
+    try:
+        from stateright_tpu.checker.breakdown import measure_pipeline_choice
+
+        res = measure_pipeline_choice(
+            spec["model"](),
+            frontier_capacity=min(
+                spec["spawn"].get("frontier_capacity", 1 << 10), 1 << 10
+            ),
+            table_capacity=min(
+                spec["spawn"].get("table_capacity", 1 << 16), 1 << 18
+            ),
+            wave_dedup=spec["spawn"].get("wave_dedup"),
+        )
+    except Exception as e:  # noqa: BLE001 - calibration is advisory
+        return {"configured": configured, "error": repr(e)}
+    res["configured"] = configured
+    if res.get("supported"):
+        mismatch = evaluate_pipeline_choice(
+            configured, res.get("fps_ms"), res.get("materialize_ms")
+        )
+        res["mismatch"] = mismatch
+        if mismatch:
+            from stateright_tpu.telemetry import metrics_registry
+
+            metrics_registry().counter("bench.pipeline_mismatch").inc()
+            log(
+                f"[{leg}] WARNING: configured pipeline {configured!r} "
+                f"measured slower than {res['measured_faster']!r} "
+                f"(fps {res['fps_ms']}ms vs materialize "
+                f"{res['materialize_ms']}ms) — pass "
+                f"expand_fps={configured != 'fps'} to spawn_tpu_bfs or "
+                "update the leg spec"
+            )
+    return res
 
 
 def _dedup_for(spec, platform: str) -> str:
@@ -623,6 +709,10 @@ def _budget_override_args():
         value = _parse_float_flag(flag)
         if value is not None:
             args += [flag, str(value)]
+    # Boolean flags forwarded verbatim (same silently-no-op hazard).
+    for flag in ("--attribution", "--no-calibrate"):
+        if flag in sys.argv:
+            args.append(flag)
     return tuple(args)
 
 
@@ -854,6 +944,10 @@ def _main_benched():
         line["storage"] = primary["storage"]
     if primary.get("hbm_budget_mib") is not None:
         line["hbm_budget_mib"] = primary["hbm_budget_mib"]
+    if primary.get("attribution"):
+        line["attribution"] = primary["attribution"]
+    if primary.get("pipeline_choice"):
+        line["pipeline_choice"] = primary["pipeline_choice"]
     for leg in ("paxos", "ilock", "abd3o", "raft5", "paxos3", "scr4"):
         if leg in results:
             line[f"{leg}_rate"] = round(results[leg]["rate"], 1)
@@ -874,6 +968,12 @@ def _main_benched():
                 line[f"{leg}_ttc_s"] = round(results[leg]["ttc_s"], 2)
             if results[leg].get("storage"):
                 line[f"{leg}_storage"] = results[leg]["storage"]
+            if results[leg].get("attribution"):
+                line[f"{leg}_attribution"] = results[leg]["attribution"]
+            if results[leg].get("pipeline_choice"):
+                line[f"{leg}_pipeline_choice"] = results[leg][
+                    "pipeline_choice"
+                ]
 
     # Judgeability (VERDICT r03 #1b): per-wave stage attribution + roofline
     # for the headline leg and the predicate-heavy ABD leg, run after the

@@ -1,0 +1,140 @@
+#!/usr/bin/env python
+"""Phase ledger + overlap headroom from an attribution-mode trace JSONL.
+
+    python scripts/gap_report.py TRACE.jsonl [--json]
+
+Reads the JSONL sink an attribution-mode run produced (``bench.py
+--attribution --trace-out ...``, or any checker spawned with
+``attribution=True`` plus ``get_tracer().add_sink(path)``) and renders,
+per checker prefix, the wave-timeline phase ledger the
+``<prefix>.pipeline`` spans carry: total wall, per-phase milliseconds and
+shares (device compute, host Bloom+run probe, evict/merge/spill,
+table growth, checkpoint, compile, residual dispatch gap), and the
+**overlap headroom** — the wall-clock a perfect async overlap of the host
+phases under device compute would reclaim, the go/no-go number for the
+pipelined wave engine (ROADMAP item 2):
+
+    headroom  = min(host_probe + evict + checkpoint, device)
+    predicted = wall - headroom
+
+``--json`` emits the ledgers as one JSON object instead of the tables
+(machine-readable; the tests consume it). The event loader, the
+``.pipeline``-span aggregation, and the phase lists are shared with
+``trace_summary.py`` (same directory) — stdlib-only, like every trace
+reader here: trace files outlive the runs that wrote them.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from trace_summary import (  # noqa: E402
+    HOST_OVERLAPPABLE,
+    PHASE_ORDER,
+    attribution_rows,
+    load_events,
+)
+
+
+def collect_ledgers(events):
+    """Per-prefix ledgers from the shared ``.pipeline`` aggregation:
+    ``{prefix: {"waves": N, "wall_ms": W, "phases_ms": {...}}}`` where
+    ``gap`` rides phases_ms like any other phase."""
+    ledgers = {}
+    for name, g in attribution_rows(events).items():
+        prefix = name[: -len(".pipeline")]
+        ledgers[prefix] = {
+            "waves": g["waves"],
+            "wall_ms": g["wall_ms"],
+            "phases_ms": dict(g["phases"]),
+        }
+    return ledgers
+
+
+def overlap_headroom(led):
+    """The headroom block for one ledger: always non-null (zero host
+    phases => zero headroom, predicted == measured)."""
+    phases = led["phases_ms"]
+    wall = led["wall_ms"]
+    device = phases.get("device", 0.0)
+    host = sum(phases.get(p, 0.0) for p in HOST_OVERLAPPABLE)
+    headroom = min(host, device)
+    return {
+        "host_overlappable_ms": host,
+        "device_ms": device,
+        "headroom_ms": headroom,
+        "headroom_pct": (100.0 * headroom / wall) if wall else 0.0,
+        "predicted_wall_ms": wall - headroom,
+    }
+
+
+def _phase_rows(phases_ms):
+    known = [p for p in PHASE_ORDER if p in phases_ms]
+    extra = sorted(p for p in phases_ms if p not in PHASE_ORDER)
+    return known + extra
+
+
+def print_ledger(prefix, led, out=sys.stdout):
+    wall = led["wall_ms"]
+    out.write(
+        f"phase ledger: {prefix} ({led['waves']} waves, "
+        f"{wall:.1f} ms wall)\n"
+    )
+    header = f"  {'phase':<12} {'ms':>10} {'share':>7}"
+    out.write(header + "\n")
+    out.write("  " + "-" * (len(header) - 2) + "\n")
+    for phase in _phase_rows(led["phases_ms"]):
+        ms = led["phases_ms"][phase]
+        share = 100.0 * ms / wall if wall else 0.0
+        mark = " *" if phase in HOST_OVERLAPPABLE else ""
+        out.write(f"  {phase:<12} {ms:>10.2f} {share:>6.1f}%{mark}\n")
+    oh = overlap_headroom(led)
+    out.write(
+        "  (* host phases an async pipelined engine could overlap)\n"
+        f"overlap headroom: {oh['headroom_ms']:.1f} ms "
+        f"({oh['headroom_pct']:.1f}% of wall) — predicted wall under "
+        f"perfect host/device overlap: {oh['predicted_wall_ms']:.1f} ms\n\n"
+    )
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="Phase ledger + overlap headroom from an "
+        "attribution-mode trace JSONL."
+    )
+    parser.add_argument("trace", help="JSONL trace file (telemetry sink)")
+    parser.add_argument(
+        "--json", action="store_true",
+        help="emit the ledgers as JSON instead of tables",
+    )
+    args = parser.parse_args(argv)
+
+    events = load_events(args.trace)
+    ledgers = collect_ledgers(events)
+    if not ledgers:
+        print(
+            f"no .pipeline attribution spans in {args.trace} — was the "
+            "run spawned with attribution=True?",
+            file=sys.stderr,
+        )
+        return 1
+    if args.json:
+        out = {
+            prefix: {**led, "overlap_headroom": overlap_headroom(led)}
+            for prefix, led in sorted(ledgers.items())
+        }
+        json.dump(out, sys.stdout, indent=2)
+        sys.stdout.write("\n")
+        return 0
+    for prefix, led in sorted(ledgers.items()):
+        print_ledger(prefix, led)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

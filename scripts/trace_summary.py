@@ -7,11 +7,15 @@ Reads the JSONL sink a checker run produced (``--trace-out`` on bench.py,
 or ``get_tracer().add_sink(path)`` on any run), prints one row per
 wave/drain span — wall ms, frontier width, generated, new-unique, dedup
 hit-rate, hash-set occupancy, and (out-of-core runs) the ``storage``
-column as ``stale-dropped/tier-resident-fps`` — and totals. Use
-``scripts/storage_report.py`` for the tier-level view (evictions, merges,
-spills, per-tier probe latency). ``--chrome-out`` additionally
-writes the Chrome trace-event export (load it in https://ui.perfetto.dev
-or chrome://tracing).
+column as ``stale-dropped/tier-resident-fps`` — and totals. On
+attribution-mode traces (``attribution=True`` runs emit ``.pipeline``
+spans) an ``attribution`` table follows: one row per span group with the
+per-phase ms share of wave wall (device/host_probe/evict/checkpoint/
+compile/gap). Use ``scripts/storage_report.py`` for the tier-level view
+(evictions, merges, spills, per-tier probe latency) and
+``scripts/gap_report.py`` for the full phase ledger + overlap-headroom
+estimate. ``--chrome-out`` additionally writes the Chrome trace-event
+export (load it in https://ui.perfetto.dev or chrome://tracing).
 
 Stdlib-only on the read path (json + argparse): trace files outlive the
 runs that wrote them and must stay inspectable on boxes without jax.
@@ -22,6 +26,17 @@ from __future__ import annotations
 import argparse
 import json
 import sys
+
+# Canonical phase order + async-overlappable host set for the script-side
+# renderers (this file and gap_report.py, which imports them). Keep in
+# sync with stateright_tpu.telemetry.attribution PHASES /
+# HOST_OVERLAPPABLE_PHASES — the scripts cannot import the package
+# because traces must stay inspectable on boxes without jax.
+PHASE_ORDER = (
+    "device", "host_probe", "evict", "table_grow", "checkpoint",
+    "compile", "gap",
+)
+HOST_OVERLAPPABLE = ("host_probe", "evict", "checkpoint")
 
 
 def load_events(path):
@@ -116,6 +131,59 @@ def print_table(rows, out=sys.stdout):
     )
 
 
+def attribution_rows(events):
+    """Per-span-group attribution aggregates from ``.pipeline`` spans
+    (attribution-mode runs): waves, total wall ms, and per-phase ms."""
+    groups = {}
+    for ev in events:
+        if ev.get("ph") != "X":
+            continue
+        name = ev.get("name", "")
+        if not name.endswith(".pipeline"):
+            continue
+        args = ev.get("args") or {}
+        if "wall_ms" not in args:
+            continue
+        g = groups.setdefault(
+            name, {"waves": 0, "wall_ms": 0.0, "phases": {}}
+        )
+        g["waves"] += 1
+        g["wall_ms"] += float(args["wall_ms"] or 0.0)
+        for k, v in args.items():
+            if k.endswith("_ms") and k != "wall_ms":
+                phase = k[: -len("_ms")]
+                g["phases"][phase] = g["phases"].get(phase, 0.0) + float(
+                    v or 0.0
+                )
+    return groups
+
+
+def print_attribution(groups, out=sys.stdout):
+    """The attribution column per span group: each phase as
+    ``ms (share%)`` of the group's summed wave wall."""
+    out.write("\nattribution (per-phase ms share of wave wall):\n")
+    header = (
+        f"{'span group':<22} {'waves':>5} {'wall ms':>10}  attribution"
+    )
+    out.write(header + "\n")
+    out.write("-" * len(header) + "\n")
+    for name in sorted(groups):
+        g = groups[name]
+        wall = g["wall_ms"]
+        phases = g["phases"]
+        keys = [p for p in PHASE_ORDER if p in phases] + sorted(
+            p for p in phases if p not in PHASE_ORDER
+        )
+        cells = " ".join(
+            f"{p}={phases[p]:.1f}ms"
+            f"({100.0 * phases[p] / wall:.0f}%)" if wall else f"{p}=0"
+            for p in keys
+        )
+        out.write(
+            f"{name:<22} {g['waves']:>5} {wall:>10.1f}  {cells}\n"
+        )
+
+
 def top_spans(events, n):
     """The n slowest complete spans, any name — where the wall time went
     (wave, drain, table_grow, storage evict/merge/probe alike)."""
@@ -175,6 +243,9 @@ def main(argv=None):
             f"{len(events)} events, none with per-wave args "
             "(host block/trace spans only)",
         )
+    attribution = attribution_rows(events)
+    if attribution:
+        print_attribution(attribution)
     if args.top:
         print()
         print_top(top_spans(events, args.top))

@@ -7,6 +7,7 @@ on-demand, simulation, TPU) returns an object with this interface.
 
 from __future__ import annotations
 
+import contextlib
 import threading
 import time
 from typing import Dict, Generic, List, Optional, TypeVar
@@ -21,10 +22,20 @@ Action = TypeVar("Action")
 EXAMPLE = "example"
 COUNTEREXAMPLE = "counterexample"
 
+# Reusable no-op context for attribution-off hot paths: nullcontext holds
+# no state, so one instance serves every call site (the attribution-off
+# overhead budget test prices exactly this object's enter/exit).
+_NULL_CTX = contextlib.nullcontext()
+
 
 class Checker(Generic[State, Action]):
     """Base class for checker handles. Subclasses implement the abstract
     accessors; joins/reports/assertions are shared."""
+
+    # Wave-timeline attribution engine (telemetry/attribution.py): the
+    # device checkers set it via _init_attribution; host engines have no
+    # device/host boundary to attribute and leave the class default.
+    _attr = None
 
     # -- abstract surface --------------------------------------------------
 
@@ -71,6 +82,64 @@ class Checker(Generic[State, Action]):
         from ..telemetry import metrics_registry
 
         return metrics_registry()
+
+    # -- wave-timeline attribution (shared by the device checkers) ---------
+
+    def _init_attribution(self, prefix: str, attribution) -> None:
+        """Installs the attribution engine when requested: ``True``
+        builds a ``WaveAttribution`` recording into ``self._tracer``, or
+        pass a pre-built engine (injectable clock — the deterministic
+        classifier tests drive a fake one). Falsy leaves attribution
+        off (the class default)."""
+        if not attribution:
+            return
+        from ..telemetry.attribution import WaveAttribution
+
+        self._attr = (
+            attribution
+            if isinstance(attribution, WaveAttribution)
+            else WaveAttribution(prefix, tracer=self._tracer)
+        )
+
+    def _phase(self, name: str):
+        """An attribution phase window, or the shared no-op context when
+        attribution is off (the off path must stay free — budget-tested)."""
+        if self._attr is None:
+            return _NULL_CTX
+        return self._attr.phase(name)
+
+    def _wave_window(self, kind: str = "wave"):
+        """One attributed wave/drain window (no-op when attribution off)."""
+        if self._attr is None:
+            return _NULL_CTX
+        return self._attr.wave(kind)
+
+    def _abort_attribution(self) -> None:
+        """Worker-error-path cleanup: closes any window the crash left
+        open so the dying wave's ``.pipeline`` span still reaches the
+        sinks and no dangling state survives into a ledger read. Never
+        raises — it must not mask the real error."""
+        if self._attr is None:
+            return
+        try:
+            self._attr.abort()
+        except Exception:  # noqa: BLE001 - never mask the worker error
+            pass
+
+    @property
+    def attribution(self):
+        """The ``WaveAttribution`` engine, or None outside attribution
+        mode."""
+        return self._attr
+
+    def attribution_report(self):
+        """The wave-timeline phase ledger
+        (``stateright_tpu.telemetry.attribution``): where real-run
+        wall-clock went between device work. None unless the backend
+        supports attribution mode and was spawned with
+        ``attribution=True`` (the device checkers are; host engines have
+        no device/host boundary to attribute)."""
+        return self._attr.report() if self._attr is not None else None
 
     def serve_monitor(self, port: int = 0, **kwargs):
         """Starts the live in-process monitor HTTP server for this run

@@ -63,7 +63,7 @@ from ..ops.hashset import (
 )
 from ..ops.ring import ring_export, ring_push, ring_rows, ring_take
 from ..telemetry import WaveInstruments, device_step_annotation, get_tracer
-from .base import Checker
+from .base import _NULL_CTX, Checker  # noqa: F401 - _NULL_CTX re-exported
 
 _DEPTH_INF = (1 << 31) - 1
 _U32_MAX = np.uint32(0xFFFFFFFF)  # numpy: keeps module import backend-free
@@ -400,6 +400,19 @@ def _make_key_fn(model, fp_fn, symmetry):
     return refined_keys
 
 
+def supports_expand_fps(model) -> bool:
+    """Whether the model provides the fingerprint-only expansion hooks
+    (``packed_expand_fps`` + ``packed_take``) AND allows them — THE
+    definition shared by the checker's auto policy and bench.py's
+    measured-policy calibration, so they cannot disagree about which
+    pipelines exist for a model."""
+    return (
+        type(model).packed_expand_fps is not BatchableModel.packed_expand_fps
+        and type(model).packed_take is not BatchableModel.packed_take
+        and model.packed_expand_fps_supported()
+    )
+
+
 def default_wave_dedup(platform: str, hashset_impl: str = "xla") -> str:
     """THE definition of the backend wave-dedup default, shared by
     ``TpuBfsChecker``, ``measure_wave_breakdown``, and ``bench.py``:
@@ -456,6 +469,7 @@ class TpuBfsChecker(Checker):
         hbm_budget_mib=None,
         host_budget_mib=None,
         spill_dir=None,
+        attribution=False,
     ):
         model = options.model
         if not isinstance(model, BatchableModel):
@@ -647,6 +661,11 @@ class TpuBfsChecker(Checker):
         # observability the offline breakdown.py stage mirror cannot give.
         self._tracer = get_tracer()
         self._wi = WaveInstruments("tpu_bfs")
+        # Wave-timeline attribution (opt-in, telemetry/attribution.py):
+        # fences each wave at phase boundaries and classifies its wall
+        # into device/host_probe/evict/table_grow/checkpoint/compile/gap.
+        # Results stay bit-identical — the fences change pacing only.
+        self._init_attribution("tpu_bfs", attribution)
         self._ingested = 0
         self._ingest_lock = threading.Lock()
         self._done_event = threading.Event()
@@ -668,12 +687,7 @@ class TpuBfsChecker(Checker):
         # materializes ONLY the fresh lanes — candidate states never
         # round-trip through HBM. ``expand_fps``: None = auto (on when
         # supported), True = require, False = force the materializing wave.
-        has_fps = (
-            type(model).packed_expand_fps
-            is not BatchableModel.packed_expand_fps
-            and type(model).packed_take is not BatchableModel.packed_take
-            and model.packed_expand_fps_supported()
-        )
+        has_fps = supports_expand_fps(model)
         if expand_fps is None:
             # Symmetry needs candidate states for orbit keys; fps path
             # yields to the materializing wave there.
@@ -1269,6 +1283,7 @@ class TpuBfsChecker(Checker):
                 self._explore()
         except BaseException as e:  # noqa: BLE001 - surfaced via worker_error
             self._error = e
+            self._abort_attribution()
         finally:
             self._done_event.set()
 
@@ -1285,10 +1300,12 @@ class TpuBfsChecker(Checker):
             with self._tracer.span(
                 "tpu_bfs.table_grow", from_capacity=self._capacity,
                 to_capacity=capacity,
-            ):
+            ), self._phase("table_grow"):
                 new_table, leftover = self._jit_rehash(
                     table, hashset_new(capacity)
                 )
+                if self._attr is not None:
+                    self._attr.fence(new_table)
             if not int(leftover):
                 break
             # A pathological key cluster can exhaust the probe cap during
@@ -1312,17 +1329,18 @@ class TpuBfsChecker(Checker):
         out-of-core alternative to doubling. Capacity settles at the
         budget cap; the emptied table carries the hot working set from
         here on while older fingerprints answer through the host probe."""
-        tab = np.asarray(table)
-        live = (tab[:, 0] != 0) | (tab[:, 1] != 0)
-        keys = (
-            tab[live, 0].astype(np.uint64) << np.uint64(32)
-        ) | tab[live, 1].astype(np.uint64)
-        self._tier.evict(keys)
-        self._capacity = self._max_capacity
-        self._l0_count = 0
-        self._wi.capacity.set(self._capacity)
-        self._tier.instruments.set_l0(0)
-        return hashset_new(self._capacity)
+        with self._phase("evict"):
+            tab = np.asarray(table)
+            live = (tab[:, 0] != 0) | (tab[:, 1] != 0)
+            keys = (
+                tab[live, 0].astype(np.uint64) << np.uint64(32)
+            ) | tab[live, 1].astype(np.uint64)
+            self._tier.evict(keys)
+            self._capacity = self._max_capacity
+            self._l0_count = 0
+            self._wi.capacity.set(self._capacity)
+            self._tier.instruments.set_l0(0)
+            return hashset_new(self._capacity)
 
     def _set_warmup(self, seconds: float) -> None:
         """First-result warmup stamp, mirrored into telemetry so traces
@@ -1456,15 +1474,38 @@ class TpuBfsChecker(Checker):
         exe = self._wave_exec.get(key)
         if exe is None:
             t0 = time.perf_counter()
+            # AOT-cache miss == a compile is about to happen: the ONE
+            # place the attribution engine can detect first-dispatch rung
+            # compiles (the cache hit path never enters this branch).
             with self._tracer.span(
                 "tpu_bfs.compile", table_capacity=key[0], frontier=key[1]
-            ):
+            ), self._phase("compile"):
                 exe = self._jit_wave.lower(*args).compile()
             self._wave_exec[key] = exe
             if self.warmup_seconds is not None:
                 self.warmup_seconds += time.perf_counter() - t0
                 self._wi.warmup.set(self.warmup_seconds)
-        return exe(*args), chunk
+        if self._attr is None:
+            return exe(*args), chunk
+        # Attribution mode: fence the wave output so the "device" phase
+        # measures dispatch + device compute, not async launch latency.
+        with self._attr.phase("device"):
+            out = exe(*args)
+            self._attr.fence(out)
+        return out, chunk
+
+    def _audit_table(self, table):
+        """Run-end audit of the probabilistic machinery: the device hash
+        set's probe-length distribution, observed into the
+        ``tpu_bfs.hashset.probe_length`` histogram (attribution mode
+        only — the table pull is a full HBM read)."""
+        if self._attr is None:
+            return
+        from ..ops.hashset import hashset_probe_length_counts
+
+        self._attr.observe_probe_lengths(
+            hashset_probe_length_counts(np.asarray(table))
+        )
 
     def _record_dispatch(self, width, live):
         """One bucketed dispatch's telemetry (gauges + per-rung counter);
@@ -1533,16 +1574,17 @@ class TpuBfsChecker(Checker):
                 and self._tier is not None
                 and not self._tier.is_empty()
             ):
-                if self._symmetry_enabled:
-                    k64 = fp64_pairs(
-                        wave["key_hi"][:n_new], wave["key_lo"][:n_new]
-                    )
-                else:
-                    k64 = fp64_pairs(
-                        wave["new"]["hi"][:n_new],
-                        wave["new"]["lo"][:n_new],
-                    )
-                stale = self._tier.probe(k64)
+                with self._phase("host_probe"):
+                    if self._symmetry_enabled:
+                        k64 = fp64_pairs(
+                            wave["key_hi"][:n_new], wave["key_lo"][:n_new]
+                        )
+                    else:
+                        k64 = fp64_pairs(
+                            wave["new"]["hi"][:n_new],
+                            wave["new"]["lo"][:n_new],
+                        )
+                    stale = self._tier.probe(k64)
                 n_stale = int(stale.sum())
                 if n_stale:
                     keep = np.flatnonzero(~stale).astype(np.int32)
@@ -1630,30 +1672,39 @@ class TpuBfsChecker(Checker):
                 and self._target_state_count <= self._state_count
             ):
                 break
-            if (
-                self._checkpoint_path is not None
-                and chunks
-                and chunks % self._checkpoint_every == 0
-                and (time.perf_counter() - last_checkpoint)
-                >= self._checkpoint_min_interval
-            ):
-                self.save_checkpoint(self._checkpoint_path, list(queue))
-                last_checkpoint = time.perf_counter()
-            chunks += 1
-            chunk = queue.popleft()
-            B = chunk["hi"].shape[0] * self._A
-            if (self._l0_count + B) > _MAX_LOAD * self._capacity:
-                table = self._grow_table(
-                    table, _pow2ceil(int((self._l0_count + B) / _MAX_LOAD))
-                )
-            with self._tracer.span(
-                "tpu_bfs.wave", wave=chunks
-            ) as sp, device_step_annotation("tpu_bfs.wave", chunks):
-                table, _ = self._consume_wave(
-                    table, None, chunk, queue, depth_cap, span=sp
-                )
+            # The attribution window covers the whole iteration (the
+            # inter-wave checkpoint and pre-grow included): its phases
+            # plus the residual gap sum to this wall by construction.
+            with self._wave_window():
+                if (
+                    self._checkpoint_path is not None
+                    and chunks
+                    and chunks % self._checkpoint_every == 0
+                    and (time.perf_counter() - last_checkpoint)
+                    >= self._checkpoint_min_interval
+                ):
+                    with self._phase("checkpoint"):
+                        self.save_checkpoint(
+                            self._checkpoint_path, list(queue)
+                        )
+                    last_checkpoint = time.perf_counter()
+                chunks += 1
+                chunk = queue.popleft()
+                B = chunk["hi"].shape[0] * self._A
+                if (self._l0_count + B) > _MAX_LOAD * self._capacity:
+                    table = self._grow_table(
+                        table,
+                        _pow2ceil(int((self._l0_count + B) / _MAX_LOAD)),
+                    )
+                with self._tracer.span(
+                    "tpu_bfs.wave", wave=chunks
+                ) as sp, device_step_annotation("tpu_bfs.wave", chunks):
+                    table, _ = self._consume_wave(
+                        table, None, chunk, queue, depth_cap, span=sp
+                    )
             if self.warmup_seconds is None:
                 self._set_warmup(time.perf_counter() - t_start)
+        self._audit_table(table)
 
     def _explore_deep(self, table, queue, depth_cap, t_start):
         """Deep-drain host loop: keeps the pending frontier in the device
@@ -1713,164 +1764,183 @@ class TpuBfsChecker(Checker):
             # Every drain exit is a checkpoint opportunity (waves-per-drain
             # is capped when a checkpoint path is set); the time floor
             # throttles the full parent-map export + pickle.
-            if (
-                self._checkpoint_path is not None
-                and drains
-                and (time.perf_counter() - last_checkpoint)
-                >= self._checkpoint_min_interval
-            ):
-                # The ring is the sole pending-frontier store here: the
-                # push loop above always fully drains the host queue.
-                assert not queue
-                self.save_checkpoint(
-                    self._checkpoint_path,
-                    self._export_pool_chunks(pool, head, count),
-                )
-                last_checkpoint = time.perf_counter()
-            drains += 1
-            if (self._l0_count + B) > _MAX_LOAD * self._capacity:
-                table = self._grow_table(
-                    table, _pow2ceil(int((self._l0_count + B) / _MAX_LOAD))
-                )
-                if self._tier is not None and not self._tier.is_empty():
-                    # The pregrow evicted (budget hit): the queue is empty
-                    # (flushed above), the ring holds the whole frontier.
-                    return table, self._handoff_queue(
-                        pool, head, count, queue
-                    )
-            undiscovered = np.array(
-                [p.name not in self._discoveries_fp for p in props]
-            )
-            # Clamp: the budget rides device int32; a > 2^31-slot table
-            # must saturate, not overflow.
-            budget = jnp.int32(
-                min(
-                    int(_MAX_LOAD * self._capacity) - self._l0_count,
-                    (1 << 31) - 1 - B,
-                )
-            )
-            # Ladder rung for this drain: the smallest bucket holding the
-            # exact pending-live count (F_max for the first drain — see
-            # live_est above). A sparse steady state drains at e.g.
-            # F_max/16 lanes per wave; the promote-exit inside the drain
-            # hands back control if the frontier outgrows the rung.
-            width = self._F_max
-            if live_est is not None and len(self._buckets) > 1:
-                want = bucket_for(
-                    self._buckets, max(1, min(live_est, self._F_max))
-                )
-                if want in self._drain_jits or want == self._F_max:
-                    width = want
-                    rung_votes = {}
-                else:
-                    votes = rung_votes.get(want, 0) + 1
-                    rung_votes = {want: votes}
-                    if votes >= 2:
-                        width = want
-                    else:
-                        # Not yet worth a compile: the narrowest rung
-                        # already compiled that still holds the load
-                        # (F_max as the floor fallback).
-                        width = min(
-                            (
-                                w
-                                for w in self._drain_jits
-                                if w >= want
-                            ),
-                            default=self._F_max,
+            # Attribution window for the whole drain iteration (the
+            # checkpoint, pre-grow, drain execution, and the final
+            # host-consumed wave). The out-of-core handoff return closes
+            # it explicitly first so the handoff's queue rebuild is not
+            # misattributed to the drain; exit is idempotent, so the
+            # with-block's unwind (normal, return, or exception) is safe
+            # either way.
+            drain_window = self._wave_window("drain")
+            with drain_window:
+                if (
+                    self._checkpoint_path is not None
+                    and drains
+                    and (time.perf_counter() - last_checkpoint)
+                    >= self._checkpoint_min_interval
+                ):
+                    # The ring is the sole pending-frontier store here: the
+                    # push loop above always fully drains the host queue.
+                    assert not queue
+                    with self._phase("checkpoint"):
+                        self.save_checkpoint(
+                            self._checkpoint_path,
+                            self._export_pool_chunks(pool, head, count),
                         )
-            args = (
-                table,
-                pool,
-                head,
-                count,
-                jnp.asarray(undiscovered),
-                budget,
-                depth_cap,
-            )
-            # Compile ahead of the real call so warmup measures pure
-            # compilation: a single deep drain can run the whole
-            # exploration, so "time until the first result returned"
-            # (the wave path's proxy) would fold exploration into
-            # warmup and corrupt steady-state rates. Mid-run compiles
-            # (new rung, grown table/ring) are measured into warmup too.
-            exe = self._drain_exe(width, args, t_start)
-            drain_span = self._tracer.span(
-                "tpu_bfs.drain", drain=drains, bucket=width
-            )
-            with drain_span, device_step_annotation("tpu_bfs.drain", drains):
-                res = exe(*args)
-                dstats = np.asarray(res["drain_stats"])
-                log_n = int(dstats[0])
-                self._state_count += int(dstats[1])
-                self._unique_count += int(dstats[2])
-                # Drains only run while the tier is empty, so every drain
-                # fresh is also an L0 resident.
-                self._l0_count += int(dstats[2])
-                self._max_depth = max(self._max_depth, int(dstats[3]))
-                # A drain consumes many waves device-side; its span carries
-                # the aggregate (per-wave granularity would need per-wave
-                # host exits — the cost the drain exists to amortize). The
-                # drain's final, unconsumed wave is accounted by the
-                # _consume_wave call below, hence waves - 1 here.
-                self._wi.drains.inc()
-                self._wi.waves.inc(max(int(dstats[4]) - 1, 0))
-                # Bucket accounting for the drain's waves: every wave in
-                # this drain ran at ``width`` lanes; the compaction ratio
-                # is live lanes over dispatched lanes, the frontier fill
-                # live lanes over F_max capacity.
-                waves_n = int(dstats[4])
-                live_sum = int(dstats[6])
-                self._wi.bucket.set(width)
-                self._wi.bucket_dispatch(width, waves_n)
-                compaction = (
-                    live_sum / (waves_n * width) if waves_n else None
-                )
-                if compaction is not None:
-                    self._wi.compaction.set(compaction)
-                    self._wi.frontier_fill.set(
-                        live_sum / (waves_n * self._F_max)
+                    last_checkpoint = time.perf_counter()
+                drains += 1
+                if (self._l0_count + B) > _MAX_LOAD * self._capacity:
+                    table = self._grow_table(
+                        table, _pow2ceil(int((self._l0_count + B) / _MAX_LOAD))
                     )
-                self._wi.record(
-                    drain_span,
-                    frontier=self._F_max,
-                    generated=int(dstats[1]),
-                    n_new=int(dstats[2]),
-                    occupancy=self._l0_count / self._capacity,
-                    capacity=self._capacity,
-                    max_depth=self._max_depth,
-                    count_wave=False,
-                    observe=False,
-                    # Final unconsumed wave rides the _consume_wave span
-                    # below — same minus-one as the waves counter above,
-                    # so monitor /status waves match the registry.
-                    waves=max(waves_n - 1, 0),
-                    log_n=log_n,
-                    ring_count=int(dstats[5]),
-                    bucket=width,
-                    compaction_ratio=compaction,
+                    if self._tier is not None and not self._tier.is_empty():
+                        # The pregrow evicted (budget hit): the queue is
+                        # empty (flushed above), the ring holds the whole
+                        # frontier. Close the window first so the
+                        # handoff's queue rebuild is not attributed to
+                        # this drain (exit is idempotent — the with's
+                        # unwind after the return is a no-op).
+                        drain_window.__exit__(None, None, None)
+                        return table, self._handoff_queue(
+                            pool, head, count, queue
+                        )
+                undiscovered = np.array(
+                    [p.name not in self._discoveries_fp for p in props]
                 )
-            pool, head, count = res["pool"], res["head"], res["count"]
-            pool_count = int(dstats[5])
-            if log_n:
-                # The whole drain's parent-fp stream in one transfer.
-                pack = np.asarray(res["log_pack"][:, :log_n])
-                self._wave_log.append(
-                    (fp64_pairs(pack[0], pack[1]), fp64_pairs(pack[2], pack[3]))
+                # Clamp: the budget rides device int32; a > 2^31-slot table
+                # must saturate, not overflow.
+                budget = jnp.int32(
+                    min(
+                        int(_MAX_LOAD * self._capacity) - self._l0_count,
+                        (1 << 31) - 1 - B,
+                    )
                 )
-                if self._symmetry_enabled:
-                    self._key_log.append(fp64_pairs(pack[4], pack[5]))
-            # Consume the final (unconsumable device-side) wave the slow
-            # way; its fresh chunks spill into the host queue and are fed
-            # back into the ring on the next loop pass.
-            with self._tracer.span("tpu_bfs.wave", drain=drains) as sp:
-                table, spilled = self._consume_wave(
-                    table, res["out"], res["frontier"], queue, depth_cap,
-                    span=sp, pending=pool_count,
+                # Ladder rung for this drain: the smallest bucket holding the
+                # exact pending-live count (F_max for the first drain — see
+                # live_est above). A sparse steady state drains at e.g.
+                # F_max/16 lanes per wave; the promote-exit inside the drain
+                # hands back control if the frontier outgrows the rung.
+                width = self._F_max
+                if live_est is not None and len(self._buckets) > 1:
+                    want = bucket_for(
+                        self._buckets, max(1, min(live_est, self._F_max))
+                    )
+                    if want in self._drain_jits or want == self._F_max:
+                        width = want
+                        rung_votes = {}
+                    else:
+                        votes = rung_votes.get(want, 0) + 1
+                        rung_votes = {want: votes}
+                        if votes >= 2:
+                            width = want
+                        else:
+                            # Not yet worth a compile: the narrowest rung
+                            # already compiled that still holds the load
+                            # (F_max as the floor fallback).
+                            width = min(
+                                (
+                                    w
+                                    for w in self._drain_jits
+                                    if w >= want
+                                ),
+                                default=self._F_max,
+                            )
+                args = (
+                    table,
+                    pool,
+                    head,
+                    count,
+                    jnp.asarray(undiscovered),
+                    budget,
+                    depth_cap,
                 )
+                # Compile ahead of the real call so warmup measures pure
+                # compilation: a single deep drain can run the whole
+                # exploration, so "time until the first result returned"
+                # (the wave path's proxy) would fold exploration into
+                # warmup and corrupt steady-state rates. Mid-run compiles
+                # (new rung, grown table/ring) are measured into warmup too.
+                exe = self._drain_exe(width, args, t_start)
+                drain_span = self._tracer.span(
+                    "tpu_bfs.drain", drain=drains, bucket=width
+                )
+                with drain_span, device_step_annotation("tpu_bfs.drain", drains):
+                    with self._phase("device"):
+                        res = exe(*args)
+                        if self._attr is not None:
+                            self._attr.fence(res)
+                    dstats = np.asarray(res["drain_stats"])
+                    log_n = int(dstats[0])
+                    self._state_count += int(dstats[1])
+                    self._unique_count += int(dstats[2])
+                    # Drains only run while the tier is empty, so every drain
+                    # fresh is also an L0 resident.
+                    self._l0_count += int(dstats[2])
+                    self._max_depth = max(self._max_depth, int(dstats[3]))
+                    # A drain consumes many waves device-side; its span carries
+                    # the aggregate (per-wave granularity would need per-wave
+                    # host exits — the cost the drain exists to amortize). The
+                    # drain's final, unconsumed wave is accounted by the
+                    # _consume_wave call below, hence waves - 1 here.
+                    self._wi.drains.inc()
+                    self._wi.waves.inc(max(int(dstats[4]) - 1, 0))
+                    # Bucket accounting for the drain's waves: every wave in
+                    # this drain ran at ``width`` lanes; the compaction ratio
+                    # is live lanes over dispatched lanes, the frontier fill
+                    # live lanes over F_max capacity.
+                    waves_n = int(dstats[4])
+                    live_sum = int(dstats[6])
+                    self._wi.bucket.set(width)
+                    self._wi.bucket_dispatch(width, waves_n)
+                    compaction = (
+                        live_sum / (waves_n * width) if waves_n else None
+                    )
+                    if compaction is not None:
+                        self._wi.compaction.set(compaction)
+                        self._wi.frontier_fill.set(
+                            live_sum / (waves_n * self._F_max)
+                        )
+                    self._wi.record(
+                        drain_span,
+                        frontier=self._F_max,
+                        generated=int(dstats[1]),
+                        n_new=int(dstats[2]),
+                        occupancy=self._l0_count / self._capacity,
+                        capacity=self._capacity,
+                        max_depth=self._max_depth,
+                        count_wave=False,
+                        observe=False,
+                        # Final unconsumed wave rides the _consume_wave span
+                        # below — same minus-one as the waves counter above,
+                        # so monitor /status waves match the registry.
+                        waves=max(waves_n - 1, 0),
+                        log_n=log_n,
+                        ring_count=int(dstats[5]),
+                        bucket=width,
+                        compaction_ratio=compaction,
+                    )
+                pool, head, count = res["pool"], res["head"], res["count"]
+                pool_count = int(dstats[5])
+                if log_n:
+                    # The whole drain's parent-fp stream in one transfer.
+                    pack = np.asarray(res["log_pack"][:, :log_n])
+                    self._wave_log.append(
+                        (fp64_pairs(pack[0], pack[1]), fp64_pairs(pack[2], pack[3]))
+                    )
+                    if self._symmetry_enabled:
+                        self._key_log.append(fp64_pairs(pack[4], pack[5]))
+                # Consume the final (unconsumable device-side) wave the slow
+                # way; its fresh chunks spill into the host queue and are fed
+                # back into the ring on the next loop pass.
+                with self._tracer.span("tpu_bfs.wave", drain=drains) as sp:
+                    table, spilled = self._consume_wave(
+                        table, res["out"], res["frontier"], queue, depth_cap,
+                        span=sp, pending=pool_count,
+                    )
             # Exact pending live lanes: the ring's count plus the final
             # wave's fresh spill — the next drain's bucket selector input.
             live_est = pool_count + spilled
+        self._audit_table(table)
 
     def _handoff_queue(self, pool, head, count, queue):
         """Builds the wave-mode chunk queue for the permanent switch out
@@ -1908,10 +1978,12 @@ class TpuBfsChecker(Checker):
                 jit_fn = jax.jit(fn, donate_argnums=(0, 1))
                 self._drain_jits[width] = jit_fn
             t0 = time.perf_counter()
+            # AOT-cache miss: the drain rung is about to compile — the
+            # attribution engine's compile-detection site for drains.
             with self._tracer.span(
                 "tpu_bfs.compile", kind="drain", bucket=width,
                 table_capacity=key[1],
-            ):
+            ), self._phase("compile"):
                 exe = jit_fn.lower(*args).compile()
             self._drain_exec[key] = exe
             if self.warmup_seconds is None:
@@ -2214,6 +2286,15 @@ class TpuBfsChecker(Checker):
         return Path.from_fingerprints(self._model, chain, fp_of=self._host_fp)
 
     # -- Checker surface ---------------------------------------------------
+
+    @property
+    def pipeline(self) -> str:
+        """The expansion pipeline this run dispatches: ``"fps"``
+        (fingerprint-only expansion, candidates never materialized) or
+        ``"materialize"`` (the full F × A state grid). bench.py's
+        measured-policy calibration compares this against the timed
+        winner."""
+        return "fps" if self._use_fps else "materialize"
 
     def model(self):
         return self._model

@@ -39,6 +39,7 @@ __all__ = [
     "hashset_insert",
     "hashset_insert_unsorted",
     "hashset_contains",
+    "hashset_probe_length_counts",
     "MAX_PROBES",
 ]
 
@@ -258,6 +259,39 @@ def hashset_insert_unsorted(
     found = found | falses.at[li].set(found2 & act2, mode="drop")
     pending_out = over | falses.at[li].set(pending2 & act2, mode="drop")
     return table, fresh, found, pending_out
+
+
+def hashset_probe_length_counts(table):
+    """Probe-chain length distribution of the RESIDENT keys: for each
+    occupied slot, the displacement from its key's home slot (linear
+    probing never wraps, so ``slot - home`` IS the probe count that
+    insert paid and every future lookup repays). Returns an int64 array
+    of length ``MAX_PROBES + 1`` where index ``d`` counts keys resting
+    ``d`` slots past home.
+
+    Audit path, not hot: pure numpy over a host copy of the table (the
+    attribution engine pulls it once at run end). The distribution is
+    the observed cost of the probabilistic machinery — a heavy tail here
+    means key clustering is eroding the nearly-sequential probe pattern
+    the sorted insert is built around."""
+    import numpy as np
+
+    tab = np.asarray(table)
+    capacity = tab.shape[0] - MAX_PROBES
+    live = (tab[:, 0] != 0) | (tab[:, 1] != 0)
+    idx = np.flatnonzero(live)
+    counts = np.zeros(MAX_PROBES + 1, np.int64)
+    if len(idx) == 0:
+        return counts
+    k = capacity.bit_length() - 1
+    if k == 0:
+        home = np.zeros(len(idx), np.int64)
+    else:
+        home = (
+            tab[idx, 0].astype(np.uint32) >> np.uint32(32 - k)
+        ).astype(np.int64)
+    disp = np.clip(idx - home, 0, MAX_PROBES)
+    return np.bincount(disp, minlength=MAX_PROBES + 1)
 
 
 def hashset_contains(

@@ -137,6 +137,7 @@ class ShardedTpuBfsChecker(Checker):
         hbm_budget_mib=None,
         host_budget_mib=None,
         spill_dir=None,
+        attribution=False,
     ):
         model = options.model
         if not isinstance(model, BatchableModel):
@@ -383,6 +384,11 @@ class ShardedTpuBfsChecker(Checker):
         # stateright_tpu.telemetry); occupancy is global across shards.
         self._tracer = get_tracer()
         self._wi = WaveInstruments("sharded_bfs")
+        # Wave-timeline attribution (opt-in, telemetry/attribution.py):
+        # same engine and phase names as TpuBfsChecker, prefixed
+        # ``sharded_bfs`` — results stay bit-identical (fences change
+        # pacing only).
+        self._init_attribution("sharded_bfs", attribution)
         self.donation_enabled = True
 
         self._handles = [
@@ -881,6 +887,7 @@ class ShardedTpuBfsChecker(Checker):
             self._explore()
         except BaseException as e:  # noqa: BLE001 - surfaced via worker_error
             self._error = e
+            self._abort_attribution()
         finally:
             self._done_event.set()
 
@@ -904,8 +911,10 @@ class ShardedTpuBfsChecker(Checker):
         while self._cap_loc < min_cap_loc:
             self._cap_loc *= 2
         while True:
-            out = self._jit_rehash(table, self._new_table())
-            if not int(self._pull(out["overflow"]).sum()):
+            with self._phase("table_grow"):
+                out = self._jit_rehash(table, self._new_table())
+                overflowed = int(self._pull(out["overflow"]).sum())
+            if not overflowed:
                 break
             # Probe-cap overflow during rehash costs capacity (retry at
             # the next doubling), never the run; under a budget the next
@@ -918,6 +927,21 @@ class ShardedTpuBfsChecker(Checker):
                 return self._evict_shards(table)
         return out["table"]
 
+    def _audit_table(self, table):
+        """Run-end probe-length audit over every shard's table (summed —
+        the shards share one hash scheme, so one distribution describes
+        them all). Attribution mode only: the pull is a full-table read."""
+        if self._attr is None:
+            return
+        from ..ops.hashset import hashset_probe_length_counts
+
+        tab = self._pull(table)  # (n, cap_loc + apron, 2)
+        counts = None
+        for d in range(self._n):
+            c = hashset_probe_length_counts(tab[d])
+            counts = c if counts is None else counts + c
+        self._attr.observe_probe_lengths(counts)
+
     def _tier_active(self) -> bool:
         return any(not t.is_empty() for t in self._tiers)
 
@@ -925,18 +949,19 @@ class ShardedTpuBfsChecker(Checker):
         """Budget-capped growth: every shard's table drains to its own
         host tier (keys stay mesh-partitioned) and the sharded set
         resets at the budget cap."""
-        tab = self._pull(table)  # (n, cap_loc + apron, 2)
-        for d in range(self._n):
-            sh = tab[d]
-            live = (sh[:, 0] != 0) | (sh[:, 1] != 0)
-            keys = (
-                sh[live, 0].astype(np.uint64) << np.uint64(32)
-            ) | sh[live, 1].astype(np.uint64)
-            self._tiers[d].evict(keys)
-        self._cap_loc = self._max_cap_loc
-        self._l0_count = 0
-        self._si.set_l0(0)
-        return self._new_table()
+        with self._phase("evict"):
+            tab = self._pull(table)  # (n, cap_loc + apron, 2)
+            for d in range(self._n):
+                sh = tab[d]
+                live = (sh[:, 0] != 0) | (sh[:, 1] != 0)
+                keys = (
+                    sh[live, 0].astype(np.uint64) << np.uint64(32)
+                ) | sh[live, 1].astype(np.uint64)
+                self._tiers[d].evict(keys)
+            self._cap_loc = self._max_cap_loc
+            self._l0_count = 0
+            self._si.set_l0(0)
+            return self._new_table()
 
     def _probe_tiers(self, keys):
         """Union membership over every shard's store (L1 then L2 inside
@@ -1090,108 +1115,115 @@ class ShardedTpuBfsChecker(Checker):
                 and self._target_state_count <= self._state_count
             ):
                 break
-            if (
-                self._checkpoint_path is not None
-                and chunks
-                and chunks % self._checkpoint_every == 0
-                and (time.perf_counter() - last_checkpoint)
-                >= self._checkpoint_min_interval
-            ):
-                self.save_checkpoint(self._checkpoint_path, self._pool)
-                last_checkpoint = time.perf_counter()
-            chunks += 1
-            B_glob = G * A
-            if (self._l0_count + B_glob) > _MAX_LOAD * n * self._cap_loc:
-                table = self._grow_table(
-                    table,
-                    _pow2ceil(
-                        int((self._l0_count + B_glob) / (_MAX_LOAD * n))
-                    ),
-                )
-            # Occupancy-adaptive dispatch: the host pool count is exact
-            # (numpy rows), so the global chunk shrinks to n × the
-            # smallest per-device ladder rung holding the pending rows —
-            # a sparse frontier expands an n×bucket grid, not n×F_loc.
-            # _pool_take's round-robin interleave then gives every shard a
-            # dense live-lane prefix at that width.
-            got = min(self._pool_count, G)
-            width = G
-            bucket = None
-            if len(self._buckets) > 1:
-                bucket = bucket_for(
-                    self._buckets, max(1, -(-got // n))
-                )
-                width = n * bucket
-                self._wi.bucket.set(bucket)
-                self._wi.bucket_dispatch(bucket)
-                self._wi.compaction.set(got / width)
-                self._wi.frontier_fill.set(got / G)
-            chunk = self._pool_take(width)
-            dev = self._put_chunk(chunk)
+            # Attribution window over the whole iteration (checkpoint +
+            # pre-grow + dispatch + harvest). No early exit lives inside
+            # it, so a plain with-block is exact; an exception unwinds
+            # the window like any context manager.
+            with self._wave_window():
+                if (
+                    self._checkpoint_path is not None
+                    and chunks
+                    and chunks % self._checkpoint_every == 0
+                    and (time.perf_counter() - last_checkpoint)
+                    >= self._checkpoint_min_interval
+                ):
+                    with self._phase("checkpoint"):
+                        self.save_checkpoint(self._checkpoint_path, self._pool)
+                    last_checkpoint = time.perf_counter()
+                chunks += 1
+                B_glob = G * A
+                if (self._l0_count + B_glob) > _MAX_LOAD * n * self._cap_loc:
+                    table = self._grow_table(
+                        table,
+                        _pow2ceil(
+                            int((self._l0_count + B_glob) / (_MAX_LOAD * n))
+                        ),
+                    )
+                # Occupancy-adaptive dispatch: the host pool count is exact
+                # (numpy rows), so the global chunk shrinks to n × the
+                # smallest per-device ladder rung holding the pending rows —
+                # a sparse frontier expands an n×bucket grid, not n×F_loc.
+                # _pool_take's round-robin interleave then gives every shard a
+                # dense live-lane prefix at that width.
+                got = min(self._pool_count, G)
+                width = G
+                bucket = None
+                if len(self._buckets) > 1:
+                    bucket = bucket_for(
+                        self._buckets, max(1, -(-got // n))
+                    )
+                    width = n * bucket
+                    self._wi.bucket.set(bucket)
+                    self._wi.bucket_dispatch(bucket)
+                    self._wi.compaction.set(got / width)
+                    self._wi.frontier_fill.set(got / G)
+                chunk = self._pool_take(width)
+                dev = self._put_chunk(chunk)
 
-            attempt = 0
-            wave_generated = 0
-            wave_new = 0
-            self._wave_stale = 0
-            with self._tracer.span(
-                "sharded_bfs.wave", wave=chunks
-            ) as sp, device_step_annotation("sharded_bfs.wave", chunks):
-                while True:
-                    wave = self._call_wave(table, dev, depth_cap)
-                    table = wave["table"]
-                    if attempt == 0:
-                        wave_generated = int(
-                            self._pull(wave["generated"]).sum()
-                        )
-                        self._state_count += wave_generated
-                        self._max_depth = max(
-                            self._max_depth,
-                            int(self._pull(wave["max_depth"]).max()),
-                        )
-                        if props:
-                            hit = self._pull(wave["prop_hit"])
-                            phi = self._pull(wave["prop_hi"])
-                            plo = self._pull(wave["prop_lo"])
-                            for i, p in enumerate(props):
-                                if p.name in self._discoveries_fp:
-                                    continue
-                                for d in range(n):
-                                    if hit[d, i]:
-                                        self._discoveries_fp[p.name] = (
-                                            fp_to_int(phi[d, i], plo[d, i])
-                                        )
-                                        break
-                        if self._visitor is not None:
-                            self._visit_chunk(chunk)
-                    wave_new += self._harvest(wave)
-                    if not int(self._pull(wave["overflow"]).sum()):
-                        break
-                    if self._max_cap_loc is not None and attempt >= 8:
-                        # Pathological key skew: one shard overflows even
-                        # freshly evicted — a configuration error, not a
-                        # loop to spin in.
-                        raise RuntimeError(
-                            "a single wave's routed keys overflow one "
-                            "budget-capped shard after repeated "
-                            "evictions; raise hbm_budget_mib or shrink "
-                            "frontier_per_device"
-                        )
-                    table = self._grow_table(table, self._cap_loc * 2)
-                    attempt += 1
-                self._record_wave_metrics(
-                    sp,
-                    width,
-                    wave_generated,
-                    wave_new,
-                    bucket=bucket,
-                    compaction_ratio=(got / width if bucket else None),
-                    live_lanes=got,
-                )
-            if self.warmup_seconds is None:
-                self.warmup_seconds = time.perf_counter() - self._t_start
-                self._wi.warmup.set(self.warmup_seconds)
-            # Re-ingest fresh rows for the next chunks.
-            del dev
+                attempt = 0
+                wave_generated = 0
+                wave_new = 0
+                self._wave_stale = 0
+                with self._tracer.span(
+                    "sharded_bfs.wave", wave=chunks
+                ) as sp, device_step_annotation("sharded_bfs.wave", chunks):
+                    while True:
+                        wave = self._call_wave(table, dev, depth_cap)
+                        table = wave["table"]
+                        if attempt == 0:
+                            wave_generated = int(
+                                self._pull(wave["generated"]).sum()
+                            )
+                            self._state_count += wave_generated
+                            self._max_depth = max(
+                                self._max_depth,
+                                int(self._pull(wave["max_depth"]).max()),
+                            )
+                            if props:
+                                hit = self._pull(wave["prop_hit"])
+                                phi = self._pull(wave["prop_hi"])
+                                plo = self._pull(wave["prop_lo"])
+                                for i, p in enumerate(props):
+                                    if p.name in self._discoveries_fp:
+                                        continue
+                                    for d in range(n):
+                                        if hit[d, i]:
+                                            self._discoveries_fp[p.name] = (
+                                                fp_to_int(phi[d, i], plo[d, i])
+                                            )
+                                            break
+                            if self._visitor is not None:
+                                self._visit_chunk(chunk)
+                        wave_new += self._harvest(wave)
+                        if not int(self._pull(wave["overflow"]).sum()):
+                            break
+                        if self._max_cap_loc is not None and attempt >= 8:
+                            # Pathological key skew: one shard overflows even
+                            # freshly evicted — a configuration error, not a
+                            # loop to spin in.
+                            raise RuntimeError(
+                                "a single wave's routed keys overflow one "
+                                "budget-capped shard after repeated "
+                                "evictions; raise hbm_budget_mib or shrink "
+                                "frontier_per_device"
+                            )
+                        table = self._grow_table(table, self._cap_loc * 2)
+                        attempt += 1
+                    self._record_wave_metrics(
+                        sp,
+                        width,
+                        wave_generated,
+                        wave_new,
+                        bucket=bucket,
+                        compaction_ratio=(got / width if bucket else None),
+                        live_lanes=got,
+                    )
+                if self.warmup_seconds is None:
+                    self.warmup_seconds = time.perf_counter() - self._t_start
+                    self._wi.warmup.set(self.warmup_seconds)
+                # Re-ingest fresh rows for the next chunks.
+                del dev
+        self._audit_table(table)
 
     def _call_wave(self, table, dev, depth_cap):
         """Wave through an AOT-compiled executable (keyed by local table
@@ -1214,11 +1246,19 @@ class ShardedTpuBfsChecker(Checker):
         exe = self._wave_exec.get(key)
         if exe is None:
             t0 = time.perf_counter()
-            exe = self._jit_wave.lower(*args).compile()
+            # AOT-cache miss: the attribution engine's compile-detection
+            # site (the hit path never enters this branch).
+            with self._phase("compile"):
+                exe = self._jit_wave.lower(*args).compile()
             self._wave_exec[key] = exe
             if self.warmup_seconds is not None:
                 self.warmup_seconds += time.perf_counter() - t0
-        return exe(*args)
+        if self._attr is None:
+            return exe(*args)
+        with self._attr.phase("device"):
+            out = exe(*args)
+            self._attr.fence(out)
+        return out
 
     # -- deep-drain host loop ---------------------------------------------
 
@@ -1283,113 +1323,124 @@ class ShardedTpuBfsChecker(Checker):
             )
             if ring_est == 0:
                 break
-            if (
-                self._checkpoint_path is not None
-                and drains
-                and (time.perf_counter() - last_checkpoint)
-                >= self._checkpoint_min_interval
-            ):
-                self._checkpoint_rings(pool, head, count)
-                last_checkpoint = time.perf_counter()
-            drains += 1
-            B_glob = G * A
-            if (self._l0_count + B_glob) > _MAX_LOAD * n * self._cap_loc:
-                table = self._grow_table(
-                    table,
-                    _pow2ceil(
-                        int((self._l0_count + B_glob) / (_MAX_LOAD * n))
-                    ),
-                )
-            undiscovered = np.array(
-                [p.name not in self._discoveries_fp for p in props]
-            )
-            # Clamp: the budget rides device int32; a huge global table
-            # (> 2^31 slots across the mesh) must saturate, not overflow.
-            budget = jnp.int32(
-                min(
-                    int(_MAX_LOAD * n * self._cap_loc) - self._l0_count,
-                    (1 << 31) - 1 - G * A,
-                )
-            )
-            args = (
-                table,
-                pool,
-                head,
-                count,
-                jnp.asarray(undiscovered),
-                budget,
-                depth_cap,
-            )
-            if not compiled:
-                # AOT-compile so the first drain (which may run the whole
-                # exploration) doesn't fold into any warmup measurement.
-                self._jit_deep_drain.lower(*args).compile()
-                compiled = True
-                if self.warmup_seconds is None:
-                    self.warmup_seconds = (
-                        time.perf_counter() - self._t_start
+            # Attribution window over the whole drain iteration. No
+            # early exit lives inside it (unlike TpuBfsChecker's, which
+            # needs the mid-loop handoff return), so a with-block is
+            # exact.
+            with self._wave_window("drain"):
+                if (
+                    self._checkpoint_path is not None
+                    and drains
+                    and (time.perf_counter() - last_checkpoint)
+                    >= self._checkpoint_min_interval
+                ):
+                    with self._phase("checkpoint"):
+                        self._checkpoint_rings(pool, head, count)
+                    last_checkpoint = time.perf_counter()
+                drains += 1
+                B_glob = G * A
+                if (self._l0_count + B_glob) > _MAX_LOAD * n * self._cap_loc:
+                    table = self._grow_table(
+                        table,
+                        _pow2ceil(
+                            int((self._l0_count + B_glob) / (_MAX_LOAD * n))
+                        ),
                     )
-                    self._wi.warmup.set(self.warmup_seconds)
-            drain_span = self._tracer.span("sharded_bfs.drain", drain=drains)
-            with drain_span, device_step_annotation(
-                "sharded_bfs.drain", drains
-            ):
-                res = self._jit_deep_drain(*args)
-                dstats = self._pull(res["drain_stats"])  # (n, 10)
-                drain_generated = int(dstats[:, 1].sum())
-                drain_new = int(dstats[:, 2].sum())
-                self._state_count += drain_generated
-                self._unique_count += drain_new
-                # Drains only run tier-empty: every fresh is L0-resident.
-                self._l0_count += drain_new
-                self._max_depth = max(
-                    self._max_depth, int(dstats[:, 3].max())
+                undiscovered = np.array(
+                    [p.name not in self._discoveries_fp for p in props]
                 )
-                # Aggregate span per drain (per-wave host exits are the
-                # cost the drain amortizes away); the final unconsumed
-                # wave is accounted by _consume_final below.
-                self._wi.drains.inc()
-                self._wi.waves.inc(int(dstats[:, 4].max()))
-                self._wi.record(
-                    drain_span,
-                    frontier=self._G,
-                    generated=drain_generated,
-                    n_new=drain_new,
-                    occupancy=self._l0_count / (self._n * self._cap_loc),
-                    capacity=self._n * self._cap_loc,
-                    max_depth=self._max_depth,
-                    count_wave=False,
-                    observe=False,
-                    waves=int(dstats[:, 4].max()),
-                    # Live pending states across all rings — the monitor's
-                    # progress fit reads this, not the capacity `frontier`.
-                    ring_count=int(dstats[:, 5].sum()),
+                # Clamp: the budget rides device int32; a huge global table
+                # (> 2^31 slots across the mesh) must saturate, not overflow.
+                budget = jnp.int32(
+                    min(
+                        int(_MAX_LOAD * n * self._cap_loc) - self._l0_count,
+                        (1 << 31) - 1 - G * A,
+                    )
                 )
-            pool, head, count = res["pool"], res["head"], res["count"]
-            ring_est = int(dstats[:, 5].max())
-            # The whole drain's parent-fp stream: one (n, 6, Ll) transfer,
-            # sliced per device by its log_n.
-            max_log = int(dstats[:, 0].max())
-            if max_log:
-                pack = self._pull(res["log_pack"][:, :, :max_log])
-                for d in range(n):
-                    ln = int(dstats[d, 0])
-                    if ln:
-                        self._wave_log.append(
-                            (
-                                fp64_pairs(pack[d, 0, :ln], pack[d, 1, :ln]),
-                                fp64_pairs(pack[d, 2, :ln], pack[d, 3, :ln]),
-                            )
+                args = (
+                    table,
+                    pool,
+                    head,
+                    count,
+                    jnp.asarray(undiscovered),
+                    budget,
+                    depth_cap,
+                )
+                if not compiled:
+                    # AOT-compile so the first drain (which may run the whole
+                    # exploration) doesn't fold into any warmup measurement.
+                    with self._phase("compile"):
+                        self._jit_deep_drain.lower(*args).compile()
+                    compiled = True
+                    if self.warmup_seconds is None:
+                        self.warmup_seconds = (
+                            time.perf_counter() - self._t_start
                         )
-                        if self._symmetry_enabled:
-                            self._key_log.append(
-                                fp64_pairs(pack[d, 4, :ln], pack[d, 5, :ln])
+                        self._wi.warmup.set(self.warmup_seconds)
+                drain_span = self._tracer.span("sharded_bfs.drain", drain=drains)
+                with drain_span, device_step_annotation(
+                    "sharded_bfs.drain", drains
+                ):
+                    with self._phase("device"):
+                        res = self._jit_deep_drain(*args)
+                        if self._attr is not None:
+                            self._attr.fence(res)
+                    dstats = self._pull(res["drain_stats"])  # (n, 10)
+                    drain_generated = int(dstats[:, 1].sum())
+                    drain_new = int(dstats[:, 2].sum())
+                    self._state_count += drain_generated
+                    self._unique_count += drain_new
+                    # Drains only run tier-empty: every fresh is L0-resident.
+                    self._l0_count += drain_new
+                    self._max_depth = max(
+                        self._max_depth, int(dstats[:, 3].max())
+                    )
+                    # Aggregate span per drain (per-wave host exits are the
+                    # cost the drain amortizes away); the final unconsumed
+                    # wave is accounted by _consume_final below.
+                    self._wi.drains.inc()
+                    self._wi.waves.inc(int(dstats[:, 4].max()))
+                    self._wi.record(
+                        drain_span,
+                        frontier=self._G,
+                        generated=drain_generated,
+                        n_new=drain_new,
+                        occupancy=self._l0_count / (self._n * self._cap_loc),
+                        capacity=self._n * self._cap_loc,
+                        max_depth=self._max_depth,
+                        count_wave=False,
+                        observe=False,
+                        waves=int(dstats[:, 4].max()),
+                        # Live pending states across all rings — the monitor's
+                        # progress fit reads this, not the capacity `frontier`.
+                        ring_count=int(dstats[:, 5].sum()),
+                    )
+                pool, head, count = res["pool"], res["head"], res["count"]
+                ring_est = int(dstats[:, 5].max())
+                # The whole drain's parent-fp stream: one (n, 6, Ll) transfer,
+                # sliced per device by its log_n.
+                max_log = int(dstats[:, 0].max())
+                if max_log:
+                    pack = self._pull(res["log_pack"][:, :, :max_log])
+                    for d in range(n):
+                        ln = int(dstats[d, 0])
+                        if ln:
+                            self._wave_log.append(
+                                (
+                                    fp64_pairs(pack[d, 0, :ln], pack[d, 1, :ln]),
+                                    fp64_pairs(pack[d, 2, :ln], pack[d, 3, :ln]),
+                                )
                             )
-            with self._tracer.span("sharded_bfs.wave", drain=drains) as sp:
-                table, pool, head, count, ring_est = self._consume_final(
-                    res, dstats, table, pool, head, count, ring_est,
-                    depth_cap, span=sp,
-                )
+                            if self._symmetry_enabled:
+                                self._key_log.append(
+                                    fp64_pairs(pack[d, 4, :ln], pack[d, 5, :ln])
+                                )
+                with self._tracer.span("sharded_bfs.wave", drain=drains) as sp:
+                    table, pool, head, count, ring_est = self._consume_final(
+                        res, dstats, table, pool, head, count, ring_est,
+                        depth_cap, span=sp,
+                    )
+        self._audit_table(table)
 
     def _consume_final(
         self, res, dstats, table, pool, head, count, ring_est, depth_cap,
@@ -1779,8 +1830,9 @@ class ShardedTpuBfsChecker(Checker):
             )
         idx = np.flatnonzero(sel)
         if self._tiers and self._tier_active():
-            keys = (key64 if key64 is not None else child64)[idx]
-            stale = self._probe_tiers(keys)
+            with self._phase("host_probe"):
+                keys = (key64 if key64 is not None else child64)[idx]
+                stale = self._probe_tiers(keys)
             self._wave_stale += int(stale.sum())
             idx = idx[~stale]
         survivors = len(idx)
@@ -1861,6 +1913,16 @@ class ShardedTpuBfsChecker(Checker):
         return Path.from_fingerprints(self._model, chain, fp_of=self._host_fp)
 
     # -- Checker surface ---------------------------------------------------
+
+    @property
+    def pipeline(self) -> str:
+        """The expansion pipeline this backend dispatches. The sharded
+        wave always materializes the candidate grid (the fps wave has no
+        sharded counterpart yet), but the property must exist so
+        bench.py's measured-policy mismatch gate is not silently inert
+        for sharded legs (``getattr(checker, "pipeline", None)`` =>
+        never flags)."""
+        return "materialize"
 
     def model(self):
         return self._model

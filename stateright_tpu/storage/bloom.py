@@ -18,11 +18,17 @@ from __future__ import annotations
 
 import numpy as np
 
-__all__ = ["BloomFilter"]
+__all__ = ["BloomFilter", "DESIGN_FP_RATE"]
 
 # ~10 bits/key at k=7: <1% false-positive rate at design load.
 BITS_PER_KEY = 10
 NUM_HASHES = 7
+# THE configured false-positive bound the sizing above targets (theory:
+# ~0.8% at design load). The observed rate is audited against this bound
+# by the `*.storage.host_probe.bloom_*` counters (tiered.py) — a two-phase
+# probe whose Bloom layer drifts past it is silently wasting block
+# decodes, which only an observed-vs-configured comparison can catch.
+DESIGN_FP_RATE = 0.01
 
 _M1 = np.uint64(0xBF58476D1CE4E5B9)
 _M2 = np.uint64(0x94D049BB133111EB)

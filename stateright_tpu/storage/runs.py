@@ -194,13 +194,22 @@ class FingerprintRun:
         found = np.zeros(len(fps), bool)
         if len(fps) == 0 or self.count == 0:
             return found
-        cand = self.bloom.contains(fps)
+        bloom_pass = self.bloom.contains(fps)
+        cand = bloom_pass.copy()
         if self.max_fp is not None:
             cand &= fps <= self.max_fp
         cand &= fps >= self.block_firsts[0]
         if stats is not None:
+            # bloom_rejects keeps its original prefilter semantics
+            # (Bloom + range); bloom_passed counts the Bloom layer ALONE
+            # so the FP audit (tiered.py) measures the filter itself —
+            # folding range rejects in would dilute the observed rate to
+            # near zero on narrow-range runs and hide Bloom drift.
             stats["bloom_rejects"] = stats.get("bloom_rejects", 0) + int(
                 len(fps) - cand.sum()
+            )
+            stats["bloom_passed"] = stats.get("bloom_passed", 0) + int(
+                bloom_pass.sum()
             )
         if not cand.any():
             return found

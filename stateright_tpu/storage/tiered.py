@@ -95,6 +95,13 @@ class StorageInstruments:
         self.probe_hits_l2 = reg.counter(f"{p}.probe_hits.l2")
         self.blocks_decoded = reg.counter(f"{p}.blocks_decoded")
         self.bloom_rejects = reg.counter(f"{p}.bloom_rejects")
+        # Bloom audit (correctness-grade observability for the
+        # probabilistic machinery): per-run probes and the keys that
+        # PASSED the prefilter but missed the binary search — observed
+        # false positives, compared against the configured
+        # ``bloom.DESIGN_FP_RATE`` (<1%) bound by the audit test.
+        self.bloom_probes = reg.counter(f"{p}.host_probe.bloom_probe_total")
+        self.bloom_fps = reg.counter(f"{p}.host_probe.bloom_fp_total")
         self.l0_resident = reg.gauge(f"{p}.l0_resident")
         self.l1_runs = reg.gauge(f"{p}.l1_runs")
         self.l1_fps = reg.gauge(f"{p}.l1_fps")
@@ -161,6 +168,13 @@ class StorageInstruments:
             "probe_keys": self.probe_keys.snapshot(),
             "probe_hits_l1": self.probe_hits_l1.snapshot(),
             "probe_hits_l2": self.probe_hits_l2.snapshot(),
+            "bloom_probe_total": self.bloom_probes.snapshot(),
+            "bloom_fp_total": self.bloom_fps.snapshot(),
+            "bloom_fp_rate": (
+                self.bloom_fps.snapshot() / self.bloom_probes.snapshot()
+                if self.bloom_probes.snapshot()
+                else None
+            ),
             "peak_l0_resident": self.peak_l0,
             "peak_l1_fps": self.peak_l1_fps,
             "peak_l2_fps": self.peak_l2_fps,
@@ -330,6 +344,8 @@ class TieredVisitedStore:
             return found
         stats: dict = {}
         hits = {"l1": 0, "l2": 0}
+        bloom_probed = 0
+        bloom_fp = 0
         with self._tracer.span(
             f"{self._span_prefix}.probe", keys=int(len(fps)),
             shard=self._shard,
@@ -339,14 +355,24 @@ class TieredVisitedStore:
                     rem = np.flatnonzero(~found)
                     if len(rem) == 0:
                         break
+                    passed0 = stats.get("bloom_passed", 0)
                     sub = run.probe(fps[rem], stats)
                     found[rem] = sub
                     hits[tier] += int(sub.sum())
+                    # Bloom audit: keys this run's BLOOM LAYER passed
+                    # (range filters excluded — they are exact, and
+                    # counting their rejects would dilute the rate) that
+                    # the run then did not contain are observed false
+                    # positives. Tracked against bloom.DESIGN_FP_RATE.
+                    passed = stats.get("bloom_passed", 0) - passed0
+                    bloom_probed += len(rem)
+                    bloom_fp += max(0, passed - int(sub.sum()))
             sp.set(
                 hits_l1=hits["l1"],
                 hits_l2=hits["l2"],
                 blocks_decoded=stats.get("blocks_decoded", 0),
                 bloom_rejects=stats.get("bloom_rejects", 0),
+                bloom_fp=bloom_fp,
             )
         self._instr.probe_batches.inc()
         self._instr.probe_keys.inc(int(len(fps)))
@@ -354,6 +380,8 @@ class TieredVisitedStore:
         self._instr.probe_hits_l2.inc(hits["l2"])
         self._instr.blocks_decoded.inc(stats.get("blocks_decoded", 0))
         self._instr.bloom_rejects.inc(stats.get("bloom_rejects", 0))
+        self._instr.bloom_probes.inc(bloom_probed)
+        self._instr.bloom_fps.inc(bloom_fp)
         return found
 
     # -- checkpoint round trip --------------------------------------------

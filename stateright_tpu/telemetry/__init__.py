@@ -1,6 +1,6 @@
 """Unified observability layer for every checker backend.
 
-Two halves, both process-local and always importable:
+Three parts, all process-local and always importable:
 
 - ``metrics``: a registry of named counters, gauges, and log-scale
   histograms with cheap ``inc``/``set``/``observe`` calls and a
@@ -10,6 +10,10 @@ Two halves, both process-local and always importable:
   trace-event exporter (loadable in Perfetto / ``chrome://tracing``),
   plus an optional ``jax.profiler`` bridge so host spans line up with
   XLA device traces.
+- ``attribution``: the opt-in wave-timeline attribution engine
+  (``WaveAttribution``) — fenced per-wave wall-clock classified into
+  device/host phases, with the overlap-headroom ledger
+  ``scripts/gap_report.py`` renders.
 
 The quantities GPU model-checking studies show must be observed *during*
 runs — frontier width per wave, dedup hit-rate, hash-set load factor —
@@ -17,6 +21,7 @@ flow through here from every backend (host BFS/DFS, on-demand,
 simulation, the TPU wave/drain loops, and the sharded mesh checker).
 """
 
+from .attribution import WaveAttribution
 from .instruments import BlockInstruments, WaveInstruments
 from .metrics import (
     Counter,
@@ -75,6 +80,7 @@ __all__ = [
     "ProgressEstimator",
     "StallWatchdog",
     "Tracer",
+    "WaveAttribution",
     "WaveInstruments",
     "chrome_trace",
     "chrome_trace_from_jsonl",

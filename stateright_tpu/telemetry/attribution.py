@@ -1,0 +1,433 @@
+"""Wave-timeline attribution: where real-run wall-clock goes between waves.
+
+The telemetry layer says *that* a run is slow (spans, counters, the live
+monitor) and ``checker/breakdown.py`` prices the jitted stages offline —
+this module attributes the wall-clock of a REAL run to the gaps between
+device work. In attribution mode (opt-in: ``spawn_tpu_bfs(...,
+attribution=True)`` / ``spawn_sharded_tpu_bfs(..., attribution=True)``)
+each host-visible wave is fenced (``jax.block_until_ready`` at phase
+boundaries) and its wall time is classified into named phases:
+
+- ``device``      — dispatch + device compute of the wave/drain executable
+- ``host_probe``  — the host tier's Bloom+run probe at the wave exit
+- ``evict``       — L0→L1 evictions (incl. the merges/spills they trigger)
+- ``table_grow``  — device-table rehash growth
+- ``checkpoint``  — checkpoint export + pickle
+- ``compile``     — rung/table-shape compiles, detected as AOT-cache
+  misses at the dispatch site (the one place a compile can happen)
+- ``gap``         — the residual: host bookkeeping, transfers the fences
+  don't cover, dispatch idle
+
+The invariant is that phases sum to the measured wave wall: ``gap`` is
+defined as the residual, so the only way the ledger can drift is phases
+OVERRUNNING the wall (clock skew, double counting) — tracked as
+``overrun_s`` and asserted under ``tolerance`` (default 5%). Phases never
+nest: an inner ``phase()`` opened while another is open records nothing,
+so call sites can wrap helpers without auditing their callees.
+
+Results surface everywhere the existing plumbing reaches: per-phase
+``<prefix>.pipeline.*`` registry counters/gauges, one
+``<prefix>.pipeline`` trace span per wave (args carry ``wall_ms``,
+``gap_ms``, and ``<phase>_ms`` — ``scripts/trace_summary.py`` renders the
+attribution table, ``scripts/gap_report.py`` the ledger + overlap
+headroom), ``monitor.pipeline.*`` in ``/status`` via the monitor sink,
+and per-leg ``attribution`` records in ``bench.py --attribution``.
+
+**Overlap headroom** is the go/no-go number for the async pipelined wave
+engine (ROADMAP item 2): the wall-clock a perfect overlap of the host
+phases (probe/evict/checkpoint) under device compute would save —
+``min(host_overlappable_s, device_s)`` — and the predicted wall under it.
+
+When ``jax.profiler`` is available and a ``profile_dir`` is set, a
+programmatic capture over the first ``profile_waves`` attributed waves is
+parsed (the Chrome-trace export XLA writes) to split device-busy from
+device-idle *inside* the ``device`` phase — the fence can only see the
+outside of the dispatch.
+
+The clock is injectable (tests drive a fake clock through the classifier
+deterministically); ``time.perf_counter`` is the default.
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+import time
+from typing import Dict, List, Optional
+
+from .metrics import MetricsRegistry, metrics_registry
+from .trace import Tracer, get_tracer
+
+__all__ = [
+    "HOST_OVERLAPPABLE_PHASES",
+    "PHASES",
+    "WaveAttribution",
+    "parse_profile_device_busy",
+]
+
+# The canonical phase names (call sites may add others; the ledger carries
+# whatever was recorded). Order is the reporting order. Mirrored by
+# scripts/trace_summary.py's PHASE_ORDER/HOST_OVERLAPPABLE — the trace
+# readers must stay importable without this package (no-jax boxes).
+PHASES = (
+    "device",
+    "host_probe",
+    "evict",
+    "table_grow",
+    "checkpoint",
+    "compile",
+)
+# Host phases an async pipelined engine could overlap under device
+# compute (ROADMAP item 2) — the numerator of the headroom estimate.
+# table_grow/compile are device-serial (the next wave needs their
+# output), so they are NOT overlappable.
+HOST_OVERLAPPABLE_PHASES = ("host_probe", "evict", "checkpoint")
+DEFAULT_TOLERANCE = 0.05
+
+
+class _Phase:
+    """One timed phase window inside (or between) waves. Non-reentrant by
+    design: if another phase is already open this one records nothing
+    (phases partition the wave wall; nesting would double-count)."""
+
+    __slots__ = ("_attr", "name", "_t0", "_active")
+
+    def __init__(self, attr: "WaveAttribution", name: str):
+        self._attr = attr
+        self.name = name
+        self._t0 = 0.0
+        self._active = False
+
+    def __enter__(self) -> "_Phase":
+        attr = self._attr
+        if attr._open_phase is None:
+            attr._open_phase = self
+            self._active = True
+            self._t0 = attr._clock()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if self._active:
+            attr = self._attr
+            attr._open_phase = None
+            attr._add_phase(self.name, attr._clock() - self._t0)
+
+
+class _Wave:
+    """One wave (or drain) window: measures wall, collects the phases
+    recorded inside it, computes the residual gap on exit, and emits the
+    ``<prefix>.pipeline`` trace span. Exit is idempotent so the worker's
+    error path can ``abort()`` a window a crashed loop left open without
+    double counting one that closed normally."""
+
+    __slots__ = ("_attr", "kind", "phases", "_t0", "_span", "_done")
+
+    def __init__(self, attr: "WaveAttribution", kind: str):
+        self._attr = attr
+        self.kind = kind
+        self.phases: Dict[str, float] = {}
+        self._done = False
+
+    def __enter__(self) -> "_Wave":
+        attr = self._attr
+        attr._current = self
+        attr._maybe_profile_start()
+        self._span = attr._tracer.span(
+            f"{attr.prefix}.pipeline", kind=self.kind
+        )
+        self._span.__enter__()
+        self._t0 = attr._clock()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if self._done:
+            return
+        self._done = True
+        attr = self._attr
+        wall = attr._clock() - self._t0
+        attr._current = None
+        residual = wall - sum(self.phases.values())
+        gap = max(0.0, residual)
+        overrun = max(0.0, -residual)
+        attr._wall_s += wall
+        attr._gap_s += gap
+        attr._overrun_s += overrun
+        if self.kind == "drain":
+            attr._drains += 1
+        else:
+            attr._waves += 1
+        attr._c_waves.inc()
+        attr._c_wall.inc(wall)
+        attr._c_gap.inc(gap)
+        if attr._wall_s > 0:
+            attr._g_util.set(attr._totals.get("device", 0.0) / attr._wall_s)
+            attr._g_gap.set(attr._gap_s / attr._wall_s)
+        self._span.set(
+            wall_ms=wall * 1e3,
+            gap_ms=gap * 1e3,
+            **{f"{k}_ms": v * 1e3 for k, v in self.phases.items()},
+        )
+        self._span.__exit__(exc_type, exc, tb)
+        attr._maybe_profile_stop()
+
+
+class WaveAttribution:
+    """The per-run attribution engine one checker owns in attribution
+    mode. ``wave()`` wraps each host-visible wave/drain window; ``phase()``
+    wraps the classified sections inside it; ``fence()`` pins async device
+    work into the surrounding phase. ``report()`` returns the ledger."""
+
+    def __init__(
+        self,
+        prefix: str,
+        clock=None,
+        tracer: Tracer = None,
+        registry: MetricsRegistry = None,
+        tolerance: float = DEFAULT_TOLERANCE,
+        profile_dir: Optional[str] = None,
+        profile_waves: int = 8,
+    ):
+        self.prefix = prefix
+        self._clock = clock if clock is not None else time.perf_counter
+        self._tracer = tracer if tracer is not None else get_tracer()
+        reg = registry if registry is not None else metrics_registry()
+        self._registry = reg
+        self.tolerance = tolerance
+        self._totals: Dict[str, float] = {}
+        # Phase time accrued OUTSIDE any wave window (seed/restore-time
+        # checkpoint reads, the restore path's table grows): reported
+        # separately so the in-wave phases + gap still sum to the wave
+        # wall — folding it into _totals would silently break the
+        # ledger invariant on every resumed run.
+        self._outside: Dict[str, float] = {}
+        self._phase_counters: Dict[str, object] = {}
+        self._wall_s = 0.0
+        self._gap_s = 0.0
+        self._overrun_s = 0.0
+        self._waves = 0
+        self._drains = 0
+        self._current: Optional[_Wave] = None
+        self._open_phase: Optional[_Phase] = None
+        p = f"{prefix}.pipeline"
+        self._c_waves = reg.counter(f"{p}.waves")
+        self._c_wall = reg.counter(f"{p}.wall_seconds")
+        self._c_gap = reg.counter(f"{p}.gap_seconds")
+        self._g_util = reg.gauge(f"{p}.utilization")
+        self._g_gap = reg.gauge(f"{p}.gap_share")
+        # Audit surface for the probabilistic machinery: the device
+        # hash set's probe-chain displacement distribution (observed at
+        # run end from the final table).
+        self._probe_hist = reg.histogram(f"{prefix}.hashset.probe_length")
+        self._probe_counts: Optional[List[int]] = None
+        # jax.profiler window (best effort, never fatal).
+        self._profile_dir = profile_dir
+        self._profile_waves = max(1, profile_waves)
+        self._profile_state = "pending" if profile_dir else "off"
+        self._profile_t0_waves = 0
+        self.device_split: Optional[Dict[str, float]] = None
+
+    # -- recording ---------------------------------------------------------
+
+    def wave(self, kind: str = "wave") -> _Wave:
+        return _Wave(self, kind)
+
+    def phase(self, name: str) -> _Phase:
+        return _Phase(self, name)
+
+    def fence(self, tree) -> None:
+        """Blocks until every device array in ``tree`` is ready, so the
+        surrounding phase window measures real work instead of async
+        dispatch latency. Tolerates non-jax leaves and missing jax."""
+        try:
+            import jax
+
+            jax.block_until_ready(tree)
+        except Exception:  # noqa: BLE001 - fencing is best effort
+            pass
+
+    def _add_phase(self, name: str, dt: float) -> None:
+        if dt < 0:
+            dt = 0.0
+        cur = self._current
+        if cur is not None:
+            cur.phases[name] = cur.phases.get(name, 0.0) + dt
+            self._totals[name] = self._totals.get(name, 0.0) + dt
+        else:
+            self._outside[name] = self._outside.get(name, 0.0) + dt
+        c = self._phase_counters.get(name)
+        if c is None:
+            c = self._registry.counter(
+                f"{self.prefix}.pipeline.{name}_seconds"
+            )
+            self._phase_counters[name] = c
+        c.inc(dt)
+
+    def abort(self) -> None:
+        """Finalizes any window a crashing loop left open (called from
+        the checker worker's error path): the open phase is flushed and
+        the wave closes normally, so the dying wave's ``.pipeline`` span
+        still reaches the trace sinks (flight-recorder forensics) and no
+        dangling ``_current``/``_open_phase`` state survives into a
+        later ledger read. Also stops a still-running profiler window.
+        No-op when nothing is open."""
+        phase = self._open_phase
+        if phase is not None:
+            phase.__exit__(None, None, None)
+        cur = self._current
+        if cur is not None:
+            cur.__exit__(None, None, None)
+        self._profile_finalize()
+
+    def observe_probe_lengths(self, counts) -> None:
+        """Feeds the device hash set's displacement counts (index =
+        probe-chain length, value = resident keys at that length) into
+        the ``<prefix>.hashset.probe_length`` log2 histogram and keeps
+        the exact counts for the ledger."""
+        counts = [int(c) for c in counts]
+        while counts and counts[-1] == 0:
+            counts.pop()
+        self._probe_counts = counts
+        for d, c in enumerate(counts):
+            if c:
+                self._probe_hist.observe_many(d, c)
+
+    # -- jax.profiler window (device-busy split) ---------------------------
+
+    def _maybe_profile_start(self) -> None:
+        if self._profile_state != "pending":
+            return
+        try:
+            import jax
+
+            jax.profiler.start_trace(self._profile_dir)
+            self._profile_state = "running"
+            self._profile_t0_waves = self._waves + self._drains
+        except Exception:  # noqa: BLE001 - profiler optional by design
+            self._profile_state = "failed"
+
+    def _maybe_profile_stop(self) -> None:
+        if self._profile_state != "running":
+            return
+        done = (self._waves + self._drains) - self._profile_t0_waves
+        if done < self._profile_waves:
+            return
+        self._profile_finalize()
+
+    def _profile_finalize(self) -> None:
+        """Stops a still-running profiler window and parses the capture.
+        Called from the window-count stop, from ``report()`` (a run that
+        finishes in fewer than ``profile_waves`` windows must not leave
+        the process profiler running — a later ``start_trace`` would
+        raise — nor its capture unwritten), and from ``abort()``."""
+        if self._profile_state != "running":
+            return
+        try:
+            import jax
+
+            jax.profiler.stop_trace()
+            self._profile_state = "done"
+            self.device_split = parse_profile_device_busy(self._profile_dir)
+        except Exception:  # noqa: BLE001
+            self._profile_state = "failed"
+
+    # -- the ledger ---------------------------------------------------------
+
+    def report(self) -> Dict[str, object]:
+        """The phase ledger: totals, shares, the sum-to-wall invariant,
+        and the overlap-headroom estimate (ROADMAP item 2's go/no-go)."""
+        self._profile_finalize()
+        wall = self._wall_s
+        phases = {k: v for k, v in sorted(self._totals.items())}
+        device = phases.get("device", 0.0)
+        host = sum(phases.get(p, 0.0) for p in HOST_OVERLAPPABLE_PHASES)
+        headroom = min(host, device)
+        out: Dict[str, object] = {
+            "prefix": self.prefix,
+            "waves": self._waves,
+            "drains": self._drains,
+            "wall_s": wall,
+            "phases_s": phases,
+            "gap_s": self._gap_s,
+            "overrun_s": self._overrun_s,
+            "tolerance": self.tolerance,
+            "within_tolerance": (
+                self._overrun_s <= self.tolerance * wall if wall else True
+            ),
+            "phase_share": (
+                {k: v / wall for k, v in phases.items()} if wall else {}
+            ),
+            "gap_share": (self._gap_s / wall) if wall else None,
+            "utilization": (device / wall) if wall else None,
+            "overlap_headroom": {
+                "host_overlappable_s": host,
+                "device_s": device,
+                "headroom_s": headroom,
+                "headroom_pct": (headroom / wall) if wall else 0.0,
+                "predicted_wall_s": wall - headroom,
+            },
+            "device_split": self.device_split,
+        }
+        if self._outside:
+            # Phase time outside any wave window (seed/restore): real,
+            # but not part of any wave's wall — reported separately so
+            # the invariant above stays exact on resumed runs.
+            out["outside_wave_s"] = {
+                k: v for k, v in sorted(self._outside.items())
+            }
+        if self._probe_counts is not None:
+            out["probe_length_counts"] = list(self._probe_counts)
+        return out
+
+
+def parse_profile_device_busy(logdir) -> Optional[Dict[str, float]]:
+    """Best-effort device-busy/idle split from a ``jax.profiler`` capture:
+    finds the newest Chrome-trace export under ``logdir`` and sums the
+    complete-event durations on device-named process tracks against the
+    tracks' observed span. Returns ``{"busy_s", "idle_s", "span_s",
+    "source"}`` or None when no device track exists (CPU-only runs) or
+    the capture is unreadable. Overlapping device events are summed, not
+    unioned — an approximation, documented as such."""
+    try:
+        paths = sorted(
+            glob.glob(
+                os.path.join(logdir, "**", "*.trace.json.gz"),
+                recursive=True,
+            ),
+            key=os.path.getmtime,
+        )
+        if not paths:
+            return None
+        with gzip.open(paths[-1], "rt") as f:
+            trace = json.load(f)
+        events = trace.get("traceEvents", [])
+        device_pids = set()
+        for ev in events:
+            if ev.get("ph") == "M" and ev.get("name") == "process_name":
+                pname = (ev.get("args") or {}).get("name", "")
+                if "/device:" in pname or pname.startswith("TPU"):
+                    device_pids.add(ev.get("pid"))
+        if not device_pids:
+            return None
+        busy_us = 0.0
+        t_lo, t_hi = None, None
+        for ev in events:
+            if ev.get("ph") != "X" or ev.get("pid") not in device_pids:
+                continue
+            ts = float(ev.get("ts", 0.0))
+            dur = float(ev.get("dur", 0.0))
+            busy_us += dur
+            t_lo = ts if t_lo is None else min(t_lo, ts)
+            t_hi = ts + dur if t_hi is None else max(t_hi, ts + dur)
+        if t_lo is None:
+            return None
+        span_us = t_hi - t_lo
+        return {
+            "busy_s": busy_us / 1e6,
+            "idle_s": max(0.0, span_us - busy_us) / 1e6,
+            "span_s": span_us / 1e6,
+            "source": "jax.profiler",
+        }
+    except Exception:  # noqa: BLE001 - profiling is advisory, never fatal
+        return None

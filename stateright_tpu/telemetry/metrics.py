@@ -80,14 +80,23 @@ class Histogram:
         self._lock = threading.Lock()
 
     def observe(self, value: Number) -> None:
+        self.observe_many(value, 1)
+
+    def observe_many(self, value: Number, n: int) -> None:
+        """``n`` identical observations in one locked update — the bulk
+        path audit consumers need (e.g. a probe-length distribution
+        arriving as per-length counts; per-key ``observe`` calls would
+        cost millions of lock round trips)."""
+        if n <= 0:
+            return
         if value > 1:
             i = min(math.ceil(math.log2(value)), self.N_BUCKETS - 1)
         else:
             i = 0
         with self._lock:
-            self.buckets[i] += 1
-            self.count += 1
-            self.sum += value
+            self.buckets[i] += n
+            self.count += n
+            self.sum += value * n
             if self.min is None or value < self.min:
                 self.min = value
             if self.max is None or value > self.max:

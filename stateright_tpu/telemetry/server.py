@@ -642,6 +642,22 @@ class MonitorCore:
         self._g_clients = self.registry.gauge("monitor.sse_clients")
         self._c_events = self.registry.counter("monitor.wave_events")
         self._c_errors = self.registry.counter("monitor.sink_errors")
+        # Pipeline attribution (telemetry/attribution.py): cumulative
+        # wall/phase sums over the run's `.pipeline` spans, surfaced as
+        # monitor.pipeline.* shares in /status and /metrics. Cumulative —
+        # a single wave's shares would flap with every checkpoint.
+        self._c_pipeline = self.registry.counter("monitor.pipeline.events")
+        self._g_pipe_util = self.registry.gauge(
+            "monitor.pipeline.utilization"
+        )
+        self._g_pipe_host = self.registry.gauge(
+            "monitor.pipeline.host_share"
+        )
+        self._g_pipe_gap = self.registry.gauge("monitor.pipeline.gap_share")
+        self._pipe_wall_ms = 0.0
+        self._pipe_device_ms = 0.0
+        self._pipe_host_ms = 0.0
+        self._pipe_gap_ms = 0.0
         self.watchdog: Optional[StallWatchdog] = None
         if stall_deadline_s is not None:
             self.watchdog = StallWatchdog(
@@ -702,6 +718,8 @@ class MonitorCore:
                           # stays honestly null.
                           frontier=args.get("pending"),
                           waves=1)
+        elif name.endswith(".pipeline") and "wall_ms" in args:
+            self._on_pipeline(name, args)
         elif ".storage." in name:
             self.broker.publish("storage", {
                 "name": name,
@@ -743,6 +761,43 @@ class MonitorCore:
             "ewma_states_per_s": est.ewma_states_per_s,
             "eta_s_low": eta_low,
             "eta_s_high": eta_high,
+        })
+
+    def _on_pipeline(self, name, args) -> None:
+        """One attribution span (args carry ``wall_ms``/``gap_ms`` and
+        ``<phase>_ms``): accumulate, refresh the monitor.pipeline.*
+        share gauges, and stream the per-wave breakdown over SSE."""
+        from .attribution import HOST_OVERLAPPABLE_PHASES
+
+        self._c_pipeline.inc()
+        wall = float(args.get("wall_ms") or 0.0)
+        device = float(args.get("device_ms") or 0.0)
+        host = sum(
+            float(args.get(f"{p}_ms") or 0.0)
+            for p in HOST_OVERLAPPABLE_PHASES
+        )
+        gap = float(args.get("gap_ms") or 0.0)
+        self._pipe_wall_ms += wall
+        self._pipe_device_ms += device
+        self._pipe_host_ms += host
+        self._pipe_gap_ms += gap
+        if self._pipe_wall_ms > 0:
+            self._g_pipe_util.set(self._pipe_device_ms / self._pipe_wall_ms)
+            self._g_pipe_host.set(self._pipe_host_ms / self._pipe_wall_ms)
+            self._g_pipe_gap.set(self._pipe_gap_ms / self._pipe_wall_ms)
+        self.broker.publish("pipeline", {
+            "name": name,
+            "wall_ms": wall,
+            "phases_ms": {
+                k[: -len("_ms")]: v
+                for k, v in args.items()
+                if k.endswith("_ms") and k != "wall_ms"
+            },
+            "utilization": (
+                self._pipe_device_ms / self._pipe_wall_ms
+                if self._pipe_wall_ms
+                else None
+            ),
         })
 
     def attach(self, checker) -> "MonitorCore":

@@ -240,6 +240,11 @@ async function refreshMonitorStatus() {
     if (hostB !== null || diskB !== null)
       $("mon-tiers").textContent =
         fmtNum(hostB || 0) + "B / " + fmtNum(diskB || 0) + "B";
+    // Pipeline attribution (attribution-mode runs only): cumulative
+    // device share of wave wall — the utilization row.
+    const util = m["monitor.pipeline.utilization"];
+    if (util !== null && util !== undefined)
+      $("mon-util").textContent = (100 * util).toFixed(1) + "%";
     const p = s.progress || {};
     if (p.max_depth !== null && p.max_depth !== undefined)
       $("mon-depth").textContent = p.max_depth;
@@ -270,6 +275,11 @@ function startMonitor() {
   });
   es.addEventListener("wave", (e) => onWaveEvent(JSON.parse(e.data)));
   es.addEventListener("storage", () => refreshMonitorStatus());
+  es.addEventListener("pipeline", (e) => {
+    const d = JSON.parse(e.data);
+    if (d.utilization !== null && d.utilization !== undefined)
+      $("mon-util").textContent = (100 * d.utilization).toFixed(1) + "%";
+  });
   es.onerror = () => {
     // Never connected => no monitor endpoints on this server: close for
     // good, panel stays hidden. Once live, errors are transient drops —

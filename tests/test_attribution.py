@@ -1,0 +1,398 @@
+"""Wave-timeline attribution (stateright_tpu.telemetry.attribution):
+fake-clock classifier units (phases sum to wall, compile/evict windows,
+nesting rules), checker integration (bit-identical results + a coherent
+ledger + the probe-length audit), the monitor's pipeline gauges, the
+gap_report/trace_summary renderers, and the attribution-OFF overhead
+budget."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from stateright_tpu.models.two_phase_commit import TwoPhaseSys
+from stateright_tpu.telemetry import metrics_registry
+from stateright_tpu.telemetry.attribution import WaveAttribution
+from stateright_tpu.telemetry.metrics import MetricsRegistry
+from stateright_tpu.telemetry.trace import Tracer
+
+REPO_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+GAP_REPORT = os.path.join(REPO_DIR, "scripts", "gap_report.py")
+TRACE_SUMMARY = os.path.join(REPO_DIR, "scripts", "trace_summary.py")
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def _attr(**kwargs):
+    clk = FakeClock()
+    tracer = Tracer()
+    attr = WaveAttribution(
+        "t", clock=clk, tracer=tracer, registry=MetricsRegistry(), **kwargs
+    )
+    return attr, clk, tracer
+
+
+# -- fake-clock classifier units -------------------------------------------
+
+
+def test_phases_sum_to_wall_with_residual_gap():
+    attr, clk, _ = _attr()
+    with attr.wave():
+        with attr.phase("device"):
+            clk.advance(2.0)
+        with attr.phase("host_probe"):
+            clk.advance(1.0)
+        clk.advance(0.5)  # unclassified host work -> gap
+    rep = attr.report()
+    assert rep["wall_s"] == pytest.approx(3.5)
+    assert rep["phases_s"]["device"] == pytest.approx(2.0)
+    assert rep["phases_s"]["host_probe"] == pytest.approx(1.0)
+    assert rep["gap_s"] == pytest.approx(0.5)
+    # The invariant: phases + gap == wall exactly (gap is the residual).
+    assert sum(rep["phases_s"].values()) + rep["gap_s"] == pytest.approx(
+        rep["wall_s"]
+    )
+    assert rep["within_tolerance"] and rep["overrun_s"] == 0.0
+    assert rep["utilization"] == pytest.approx(2.0 / 3.5)
+
+
+def test_compile_detection_and_evict_window_classified():
+    attr, clk, _ = _attr()
+    with attr.wave():
+        with attr.phase("compile"):
+            clk.advance(4.0)
+        with attr.phase("device"):
+            clk.advance(1.0)
+        with attr.phase("evict"):
+            clk.advance(2.0)
+        with attr.phase("checkpoint"):
+            clk.advance(0.5)
+    rep = attr.report()
+    assert rep["phases_s"]["compile"] == pytest.approx(4.0)
+    assert rep["phases_s"]["evict"] == pytest.approx(2.0)
+    # Overlap headroom: only the HOST phases (probe/evict/checkpoint)
+    # can hide under device compute, capped by the device time there is
+    # to hide them under — compile/table_grow are device-serial.
+    oh = rep["overlap_headroom"]
+    assert oh["host_overlappable_s"] == pytest.approx(2.5)
+    assert oh["device_s"] == pytest.approx(1.0)
+    assert oh["headroom_s"] == pytest.approx(1.0)
+    assert oh["predicted_wall_s"] == pytest.approx(rep["wall_s"] - 1.0)
+
+
+def test_nested_phase_records_nothing():
+    attr, clk, _ = _attr()
+    with attr.wave():
+        with attr.phase("device"):
+            with attr.phase("evict"):  # nested: ignored by design
+                clk.advance(1.0)
+            clk.advance(1.0)
+    rep = attr.report()
+    assert rep["phases_s"]["device"] == pytest.approx(2.0)
+    assert "evict" not in rep["phases_s"]
+    assert rep["gap_s"] == pytest.approx(0.0)
+
+
+def test_phase_outside_wave_reported_separately():
+    """Seed/restore-time phases (no wave window open) must NOT inflate
+    the in-wave ledger — folding them into phases_s would break the
+    phases-sum-to-wall invariant on every resumed run."""
+    attr, clk, _ = _attr()
+    with attr.phase("checkpoint"):  # e.g. a restore-time table rebuild
+        clk.advance(3.0)
+    with attr.wave():
+        with attr.phase("device"):
+            clk.advance(1.0)
+    rep = attr.report()
+    assert "checkpoint" not in rep["phases_s"]
+    assert rep["outside_wave_s"]["checkpoint"] == pytest.approx(3.0)
+    assert rep["wall_s"] == pytest.approx(1.0)
+    assert sum(rep["phases_s"].values()) + rep["gap_s"] == pytest.approx(
+        rep["wall_s"]
+    )
+    assert rep["within_tolerance"]
+
+
+def test_wave_kind_drain_counts_drains_and_span_args():
+    attr, clk, tracer = _attr()
+    with attr.wave("drain"):
+        with attr.phase("device"):
+            clk.advance(1.5)
+        clk.advance(0.5)
+    rep = attr.report()
+    assert rep["drains"] == 1 and rep["waves"] == 0
+    (ev,) = [e for e in tracer.events() if e["name"] == "t.pipeline"]
+    assert ev["args"]["kind"] == "drain"
+    assert ev["args"]["wall_ms"] == pytest.approx(2000.0)
+    assert ev["args"]["device_ms"] == pytest.approx(1500.0)
+    assert ev["args"]["gap_ms"] == pytest.approx(500.0)
+
+
+def test_observe_probe_lengths_feeds_histogram_and_ledger():
+    attr, _, _ = _attr()
+    attr.observe_probe_lengths([10, 5, 0, 1, 0, 0])
+    rep = attr.report()
+    assert rep["probe_length_counts"] == [10, 5, 0, 1]
+    hist = attr._registry.histogram("t.hashset.probe_length").snapshot()
+    assert hist["count"] == 16
+    assert hist["max"] == 3
+
+
+def test_probe_length_counts_match_resident_keys():
+    import jax.numpy as jnp
+
+    from stateright_tpu.ops.hashset import (
+        hashset_insert_unsorted,
+        hashset_new,
+        hashset_probe_length_counts,
+    )
+
+    rng = np.random.default_rng(3)
+    hi = jnp.asarray(rng.integers(1, 1 << 32, 500, dtype=np.uint32))
+    lo = jnp.asarray(rng.integers(1, 1 << 32, 500, dtype=np.uint32))
+    table, fresh, _found, pending = hashset_insert_unsorted(
+        hashset_new(1 << 10), hi, lo, jnp.ones((500,), bool)
+    )
+    assert not bool(pending.any())
+    counts = hashset_probe_length_counts(np.asarray(table))
+    assert counts.sum() == int(fresh.sum())
+
+
+# -- monitor surface --------------------------------------------------------
+
+
+def test_monitor_pipeline_gauges_and_sse_event():
+    from stateright_tpu.telemetry.server import MonitorCore
+
+    reg = MetricsRegistry()
+    tracer = Tracer()
+    core = MonitorCore(registry=reg, tracer=tracer)
+    try:
+        q = core.broker.subscribe()
+        core.write_event({
+            "name": "tpu_bfs.pipeline", "ph": "X", "ts": 0.0, "dur": 4000.0,
+            "pid": 1, "tid": 1,
+            "args": {"kind": "wave", "wall_ms": 4.0, "device_ms": 3.0,
+                     "host_probe_ms": 0.5, "gap_ms": 0.5},
+        })
+        assert reg.gauge("monitor.pipeline.utilization").snapshot() == (
+            pytest.approx(0.75)
+        )
+        assert reg.gauge("monitor.pipeline.host_share").snapshot() == (
+            pytest.approx(0.125)
+        )
+        kind, payload = q.get(timeout=2)
+        assert kind == "pipeline"
+        assert payload["phases_ms"]["device"] == pytest.approx(3.0)
+        assert payload["utilization"] == pytest.approx(0.75)
+    finally:
+        core.close()
+
+
+# -- checker integration ----------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def base_run():
+    """Unattributed 2pc-4 on the wave path: the bit-identical oracle and
+    the overhead budget's real-run denominator."""
+    reg = metrics_registry()
+    waves0 = reg.counter("tpu_bfs.waves").snapshot()
+    t0 = time.perf_counter()
+    checker = (
+        TwoPhaseSys(4)
+        .checker()
+        .spawn_tpu_bfs(
+            frontier_capacity=1 << 7,
+            table_capacity=1 << 12,
+            max_drain_waves=1,
+        )
+        .join()
+    )
+    secs = time.perf_counter() - t0
+    waves = reg.counter("tpu_bfs.waves").snapshot() - waves0
+    return checker, secs, waves
+
+
+@pytest.fixture(scope="module")
+def attributed_run():
+    """Attribution-mode 2pc-4 on the default deep-drain path."""
+    return (
+        TwoPhaseSys(4)
+        .checker()
+        .spawn_tpu_bfs(
+            frontier_capacity=1 << 7,
+            table_capacity=1 << 12,
+            attribution=True,
+        )
+        .join()
+    )
+
+
+def test_attribution_results_bit_identical(base_run, attributed_run):
+    base, _, _ = base_run
+    assert attributed_run.unique_state_count() == base.unique_state_count()
+    assert attributed_run.state_count() == base.state_count()
+    assert attributed_run.max_depth() == base.max_depth()
+    assert sorted(attributed_run.discoveries()) == sorted(
+        base.discoveries()
+    )
+
+
+def test_attribution_ledger_sums_and_detects_compile(attributed_run):
+    rep = attributed_run.attribution_report()
+    assert rep is not None
+    # The acceptance invariant: phases + gap == wall within tolerance
+    # (gap is residual, so only an overrun can break it).
+    assert rep["within_tolerance"], rep
+    total = sum(rep["phases_s"].values()) + rep["gap_s"]
+    assert total == pytest.approx(rep["wall_s"], rel=0.05)
+    # Compile detection: the run's first drain/wave misses the AOT cache.
+    assert rep["phases_s"].get("compile", 0) > 0
+    assert rep["phases_s"].get("device", 0) > 0
+    assert rep["waves"] + rep["drains"] >= 1
+    # Overlap headroom is always non-null (zero host work => zero).
+    oh = rep["overlap_headroom"]
+    assert oh["predicted_wall_s"] is not None
+    assert oh["predicted_wall_s"] <= rep["wall_s"]
+    # Probe-length audit covers every resident key (no tier: L0 holds
+    # the full visited set).
+    assert sum(rep["probe_length_counts"]) == (
+        attributed_run.unique_state_count()
+    )
+
+
+def test_attribution_report_none_when_disabled(base_run):
+    base, _, _ = base_run
+    assert base.attribution_report() is None
+
+
+def test_sharded_attribution_ledger_and_identical_counts():
+    checker = (
+        TwoPhaseSys(3)
+        .checker()
+        .spawn_sharded_tpu_bfs(
+            frontier_per_device=1 << 5,
+            table_capacity_per_device=1 << 10,
+            attribution=True,
+        )
+        .join()
+    )
+    assert checker.unique_state_count() == 288
+    rep = checker.attribution_report()
+    assert rep["within_tolerance"], rep
+    assert rep["phases_s"].get("device", 0) > 0
+    assert sum(rep["probe_length_counts"]) == 288
+
+
+# -- attribution-off overhead budget ----------------------------------------
+
+
+def test_attribution_off_overhead_under_budget(base_run):
+    """With attribution disabled the checkers pay one shared-nullcontext
+    enter/exit per hook site per wave. Same form as the telemetry/monitor
+    budget tests: the measured per-wave disabled-path cost times a real
+    run's wave count must stay under 5% of that run's wall (direct A/B
+    of sub-second runs on this shared box swings more than the budget
+    being asserted)."""
+    from stateright_tpu.checker.tpu import _NULL_CTX
+
+    _, run_secs, waves = base_run
+    assert waves >= 1
+    sites = 6  # wave window + device + probe + grow + checkpoint + evict
+    n = 10_000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        for _ in range(sites):
+            with _NULL_CTX:
+                pass
+    per_wave = (time.perf_counter() - t0) / n
+    overhead = per_wave * waves
+    assert overhead < 0.05 * run_secs, (
+        f"attribution-off overhead too high: {waves} waves x "
+        f"{per_wave * 1e6:.1f}us = {overhead * 1e3:.2f}ms on a "
+        f"{run_secs * 1e3:.0f}ms run"
+    )
+
+
+# -- gap_report / trace_summary renderers -----------------------------------
+
+
+def _pipeline_event(wall, device, probe, gap, name="tpu_bfs.pipeline"):
+    return {
+        "name": name, "ph": "X", "ts": 1.0, "dur": wall * 1e3,
+        "args": {"kind": "wave", "wall_ms": wall, "device_ms": device,
+                 "host_probe_ms": probe, "gap_ms": gap},
+    }
+
+
+def test_gap_report_ledger_and_nonnull_headroom(tmp_path):
+    path = tmp_path / "attr.jsonl"
+    with open(path, "w") as f:
+        f.write(json.dumps(_pipeline_event(10.0, 6.0, 3.0, 1.0)) + "\n")
+        f.write(json.dumps(_pipeline_event(8.0, 5.0, 2.0, 1.0)) + "\n")
+    r = subprocess.run(
+        [sys.executable, GAP_REPORT, str(path)],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert r.returncode == 0, r.stderr
+    assert "phase ledger: tpu_bfs (2 waves" in r.stdout
+    assert "overlap headroom: 5.0 ms" in r.stdout  # min(5 probe, 11 dev)
+    assert "predicted wall under" in r.stdout
+
+    r = subprocess.run(
+        [sys.executable, GAP_REPORT, str(path), "--json"],
+        capture_output=True, text=True, timeout=60,
+    )
+    led = json.loads(r.stdout)["tpu_bfs"]
+    assert led["overlap_headroom"]["headroom_ms"] == pytest.approx(5.0)
+    assert led["overlap_headroom"]["predicted_wall_ms"] == pytest.approx(
+        13.0
+    )
+
+
+def test_gap_report_exits_nonzero_without_attribution_spans(tmp_path):
+    path = tmp_path / "plain.jsonl"
+    path.write_text(
+        json.dumps({"name": "tpu_bfs.wave", "ph": "X", "ts": 1.0,
+                    "dur": 5.0, "args": {"new_unique": 3}}) + "\n"
+    )
+    r = subprocess.run(
+        [sys.executable, GAP_REPORT, str(path)],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert r.returncode == 1
+    assert "attribution" in r.stderr
+
+
+def test_trace_summary_attribution_table(tmp_path):
+    path = tmp_path / "attr.jsonl"
+    with open(path, "w") as f:
+        f.write(json.dumps({
+            "name": "tpu_bfs.wave", "ph": "X", "ts": 1.0, "dur": 5000.0,
+            "args": {"frontier": 4, "generated": 8, "new_unique": 4,
+                     "dedup_hit_rate": 0.5, "occupancy": 0.1,
+                     "max_depth": 2},
+        }) + "\n")
+        f.write(json.dumps(_pipeline_event(10.0, 7.0, 2.0, 1.0)) + "\n")
+    r = subprocess.run(
+        [sys.executable, TRACE_SUMMARY, str(path)],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert r.returncode == 0, r.stderr
+    assert "attribution (per-phase ms share of wave wall):" in r.stdout
+    assert "tpu_bfs.pipeline" in r.stdout
+    assert "device=7.0ms(70%)" in r.stdout

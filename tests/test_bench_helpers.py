@@ -57,3 +57,22 @@ def test_sentinel_device_results_none_without_tpu(tmp_path, monkeypatch):
     runs.write_text('{"leg": "2pc", "result": {"device": "cpu"}}\n')
     monkeypatch.setattr(bench, "REPO_DIR", str(tmp_path))
     assert bench._sentinel_device_results() is None
+
+
+def test_evaluate_pipeline_choice_flags_slower_configured():
+    """The measured-policy gate (PR 7 satellite): the configured pipeline
+    is flagged only when it measures >10% slower than the other one —
+    both directions, noise band tolerated, degenerate inputs never flag."""
+    # abd3o-shaped regression: configured fps, materialize 2.5x faster.
+    assert bench.evaluate_pipeline_choice("fps", 25.0, 10.0) is True
+    # configured materialize, fps faster.
+    assert bench.evaluate_pipeline_choice("materialize", 10.0, 25.0) is True
+    # Correctly-configured pipelines never flag.
+    assert bench.evaluate_pipeline_choice("fps", 10.0, 25.0) is False
+    assert bench.evaluate_pipeline_choice("materialize", 25.0, 10.0) is False
+    # Inside the 10% noise band: no flag either way.
+    assert bench.evaluate_pipeline_choice("fps", 10.5, 10.0) is False
+    # Degenerate inputs (unsupported model, failed calibration).
+    assert bench.evaluate_pipeline_choice(None, 10.0, 5.0) is False
+    assert bench.evaluate_pipeline_choice("fps", None, 5.0) is False
+    assert bench.evaluate_pipeline_choice("fps", 10.0, 0.0) is False

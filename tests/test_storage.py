@@ -131,6 +131,42 @@ def test_store_merges_at_threshold_and_dedups_cross_run_twins():
     assert store.probe(np.unique(batch)).all()
 
 
+def test_bloom_fp_audit_counters_within_configured_bound():
+    """Audit counters for the probabilistic machinery: the two-phase
+    probe emits ``*.storage.host_probe.bloom_probe_total`` /
+    ``bloom_fp_total``, the OBSERVED false-positive rate stays under 2x
+    the configured design bound (<1%, ``bloom.DESIGN_FP_RATE``), and the
+    probe never drops a negative (a fresh key reported visited would
+    silently lose a state) nor misses a positive (a visited key reported
+    fresh would corrupt counts)."""
+    from stateright_tpu.storage.bloom import DESIGN_FP_RATE
+
+    store = TieredVisitedStore(prefix="t_bloom_audit")
+    rng = np.random.default_rng(21)
+    present = np.unique(rng.integers(1, 1 << 62, 50_000, dtype=np.uint64))
+    store.evict(present)
+
+    absent = rng.integers(1, 1 << 62, 60_000, dtype=np.uint64)
+    absent = absent[~np.isin(absent, present)]
+    # Exactness both ways: the Bloom layer only prefilters — the binary
+    # search corrects every false positive before the checker sees it.
+    assert not store.probe(absent).any()
+    assert store.probe(present).all()
+
+    reg = metrics_registry()
+    probes = reg.counter(
+        "t_bloom_audit.storage.host_probe.bloom_probe_total"
+    ).snapshot()
+    fps = reg.counter(
+        "t_bloom_audit.storage.host_probe.bloom_fp_total"
+    ).snapshot()
+    assert probes >= len(absent)
+    # present-key probes produce no FPs, so rate-vs-absent is the honest
+    # denominator; with 60k absent probes the 2x margin is >25 sigma.
+    assert fps / len(absent) < 2 * DESIGN_FP_RATE, (fps, len(absent))
+    assert store.instruments.bench_stats()["bloom_fp_rate"] is not None
+
+
 def test_store_spills_past_host_budget_and_probes_union(tmp_path):
     store = TieredVisitedStore(
         host_budget_mib=0.02, spill_dir=str(tmp_path), prefix="t_spill"
