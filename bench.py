@@ -313,6 +313,14 @@ def _run_leg(leg: str, pin_cpu: bool):
     if "--attribution" in sys.argv:
         spec["spawn"]["attribution"] = True
         out["attribution_enabled"] = True
+    # State-space cartography (--coverage): the in-wave coverage
+    # reductions (telemetry/coverage.py) ride the run; the per-leg
+    # record carries the full report (actions/properties/shape/vacuity).
+    # Results stay bit-identical — only the extra per-wave vector pull
+    # changes pacing.
+    if "--coverage" in sys.argv:
+        spec["spawn"]["coverage"] = True
+        out["coverage_enabled"] = True
     if spec.get("host_baseline") and "--no-host-baseline" not in sys.argv:
         t0 = time.time()
         host = (
@@ -485,6 +493,11 @@ def _run_leg(leg: str, pin_cpu: bool):
     attribution = checker.attribution_report()
     if attribution is not None:
         out["attribution"] = attribution
+    # Coverage record: the state-space cartography + vacuity verdict
+    # (scripts/coverage_report.py renders the same data from the trace).
+    cov = checker.coverage_report()
+    if cov is not None:
+        out["coverage"] = cov
     want = spec.get("expect_discovery")
     if want is not None:
         path = checker.discoveries().get(want)
@@ -710,7 +723,7 @@ def _budget_override_args():
         if value is not None:
             args += [flag, str(value)]
     # Boolean flags forwarded verbatim (same silently-no-op hazard).
-    for flag in ("--attribution", "--no-calibrate"):
+    for flag in ("--attribution", "--coverage", "--no-calibrate"):
         if flag in sys.argv:
             args.append(flag)
     return tuple(args)
@@ -946,6 +959,8 @@ def _main_benched():
         line["hbm_budget_mib"] = primary["hbm_budget_mib"]
     if primary.get("attribution"):
         line["attribution"] = primary["attribution"]
+    if primary.get("coverage"):
+        line["coverage"] = primary["coverage"]
     if primary.get("pipeline_choice"):
         line["pipeline_choice"] = primary["pipeline_choice"]
     for leg in ("paxos", "ilock", "abd3o", "raft5", "paxos3", "scr4"):
@@ -970,6 +985,8 @@ def _main_benched():
                 line[f"{leg}_storage"] = results[leg]["storage"]
             if results[leg].get("attribution"):
                 line[f"{leg}_attribution"] = results[leg]["attribution"]
+            if results[leg].get("coverage"):
+                line[f"{leg}_coverage"] = results[leg]["coverage"]
             if results[leg].get("pipeline_choice"):
                 line[f"{leg}_pipeline_choice"] = results[leg][
                     "pipeline_choice"

@@ -1,0 +1,215 @@
+#!/usr/bin/env python
+"""State-space cartography from a coverage-mode trace JSONL, with a CI
+vacuity gate.
+
+    python scripts/coverage_report.py TRACE.jsonl [--json] [--no-gate]
+
+Reads the JSONL sink a coverage-recording run produced (``bench.py
+--coverage --trace-out ...``, any device checker spawned with
+``coverage=True``, or any host engine — they are always-on — plus
+``get_tracer().add_sink(path)``) and renders, per checker prefix, the
+full coverage report the ``<prefix>.coverage.summary`` instant carries:
+the per-action fired/fresh table (dead actions flagged), the
+per-property exercise table (antecedent vacuity, ``sometimes``
+witnesses + near-miss depth, ``eventually`` met population), and the
+state-space shape (new-unique-per-depth histogram, successors-per-state
+log2 histogram, terminal states, revisit rate, orbit compression).
+
+Exit codes (the CI contract):
+
+- ``0`` — coverage data found, no vacuity findings;
+- ``1`` — vacuity findings: dead actions, an ``always`` whose declared
+  antecedent never fired, or an undiscovered ``sometimes`` (suppress
+  with ``--no-gate`` to render only);
+- ``2`` — no coverage summaries in the trace (was the run spawned with
+  ``coverage=True``? host-engine runs emit them always).
+
+``--json`` emits the reports as one JSON object keyed by prefix
+(machine-readable; the tests consume it), same convention as
+``gap_report.py --json`` / ``storage_report.py --json``.
+
+Stdlib-only, like every trace reader here: trace files outlive the runs
+that wrote them and must stay inspectable on boxes without jax.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from trace_summary import load_events  # noqa: E402
+
+
+def collect_reports(events):
+    """The LAST ``<prefix>.coverage.summary`` instant per prefix (host
+    engines emit one per worker shutdown; the final one carries the
+    complete totals)."""
+    reports = {}
+    for ev in events:
+        name = ev.get("name", "")
+        if not name.endswith(".coverage.summary"):
+            continue
+        report = (ev.get("args") or {}).get("report")
+        if isinstance(report, dict):
+            reports[name[: -len(".coverage.summary")]] = report
+    return reports
+
+
+def _bar(n, peak, width=24):
+    if not peak:
+        return ""
+    return "#" * max(1 if n else 0, round(width * n / peak))
+
+
+def print_report(prefix, rep, out=sys.stdout):
+    w = out.write
+    w(
+        f"coverage: {prefix} — {rep.get('evaluated', 0)} evaluated, "
+        f"{rep.get('generated', 0)} generated, {rep.get('unique', 0)} "
+        f"unique, {rep.get('terminal_states', 0)} terminal, "
+        f"{100.0 * rep.get('revisit_rate', 0.0):.1f}% revisit\n"
+    )
+    actions = rep.get("actions") or {}
+    table = actions.get("table") or {}
+    if table:
+        peak = max((v.get("fired", 0) for v in table.values()), default=0)
+        w(f"\n  {'action':<24} {'fired':>10} {'fresh':>10}  coverage\n")
+        w("  " + "-" * 60 + "\n")
+        for label, v in table.items():
+            fired, fresh = v.get("fired", 0), v.get("fresh", 0)
+            flag = (
+                " DEAD" if fired == 0
+                else " never-new" if fresh == 0
+                else ""
+            )
+            w(
+                f"  {label:<24} {fired:>10} {fresh:>10}  "
+                f"{_bar(fired, peak)}{flag}\n"
+            )
+    props = rep.get("properties") or {}
+    if props:
+        w(f"\n  {'property':<32} {'kind':<10} {'exercised':>9}  verdict\n")
+        w("  " + "-" * 66 + "\n")
+        for name, p in props.items():
+            kind = p.get("expectation", "?")
+            ex = p.get("exercised", 0)
+            if kind == "sometimes":
+                verdict = (
+                    "witnessed"
+                    if p.get("discovered") or ex
+                    else "NOT DISCOVERED"
+                    + (
+                        f" (near-miss depth {p['near_miss_depth']})"
+                        if p.get("near_miss_depth") is not None
+                        else ""
+                    )
+                )
+            elif kind == "always":
+                verdict = (
+                    "VACUOUS (antecedent never fired)"
+                    if p.get("has_antecedent") and ex == 0
+                    else "violated" if p.get("discovered") else "exercised"
+                )
+            else:  # eventually
+                verdict = (
+                    "violated" if p.get("discovered")
+                    else "held" if ex else "condition never met"
+                )
+            w(f"  {name:<32} {kind:<10} {ex:>9}  {verdict}\n")
+    shape = rep.get("shape") or {}
+    depth_hist = shape.get("depth_hist") or []
+    if depth_hist:
+        peak = max(depth_hist)
+        w("\n  new unique per depth:\n")
+        for d, n in enumerate(depth_hist):
+            if n:
+                w(f"    d={d:<4} {n:>9}  {_bar(n, peak)}\n")
+        if shape.get("depth_saturated"):
+            w("    (last bin saturates: deeper states folded in)\n")
+    succ = shape.get("succ_hist_log2") or []
+    if succ:
+        peak = max(succ)
+        w("  successors per state (log2 bins):\n")
+        for b, n in enumerate(succ):
+            if n:
+                label = "<=1" if b == 0 else f"<={1 << b}"
+                w(f"    {label:<6} {n:>9}  {_bar(n, peak)}\n")
+    sym = rep.get("symmetry")
+    if sym and sym.get("orbit_compression"):
+        w(
+            f"  orbit compression: {sym['orbit_compression']:.2f}x "
+            f"({sym['wave_distinct_fps']} wave-distinct fps over "
+            f"{sym['wave_distinct_orbits']} orbits)\n"
+        )
+    vac = rep.get("vacuity") or {}
+    findings = [
+        f"{kind.replace('_', ' ')}: {', '.join(items)}"
+        for kind, items in vac.items()
+        if items
+    ]
+    if findings:
+        w("\n  VACUITY FINDINGS:\n")
+        for f in findings:
+            w(f"    - {f}\n")
+    else:
+        w("\n  no vacuity findings\n")
+    w("\n")
+    return bool(findings)
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="State-space coverage/vacuity report from a "
+        "coverage-mode trace JSONL."
+    )
+    parser.add_argument("trace", help="JSONL trace file (telemetry sink)")
+    parser.add_argument(
+        "--json", action="store_true",
+        help="emit the reports as one JSON object instead of the tables",
+    )
+    parser.add_argument(
+        "--no-gate", action="store_true",
+        help="always exit 0 on rendered reports, even with vacuity "
+        "findings (report-only mode)",
+    )
+    args = parser.parse_args(argv)
+
+    events = load_events(args.trace)
+    reports = collect_reports(events)
+    if not reports:
+        print(
+            f"no .coverage.summary instants in {args.trace} — was the "
+            "run spawned with coverage=True? (host engines always emit "
+            "them)",
+            file=sys.stderr,
+        )
+        return 2
+    vacuous = False
+    if args.json:
+        json.dump(
+            dict(sorted(reports.items())), sys.stdout, indent=2,
+            sort_keys=True,
+        )
+        sys.stdout.write("\n")
+        vacuous = any(r.get("vacuous") for r in reports.values())
+    else:
+        for prefix, rep in sorted(reports.items()):
+            if print_report(prefix, rep):
+                vacuous = True
+    if vacuous and not args.no_gate:
+        print(
+            "vacuity findings present (dead actions / unexercised "
+            "always / undiscovered sometimes) — failing the gate; use "
+            "--no-gate to render only",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

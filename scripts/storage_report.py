@@ -160,7 +160,12 @@ def main(argv=None):
         return 1
     s = summarize(events)
     if args.json:
-        print(json.dumps(s))
+        # One JSON object on stdout — the shared machine-readable
+        # convention (gap_report.py --json, coverage_report.py --json,
+        # bench_compare's single-line leg files): dashboards consume it
+        # without scraping the table.
+        json.dump(s, sys.stdout, indent=2, sort_keys=True)
+        sys.stdout.write("\n")
         return 0
     if not any(
         s[k]["count"] for k in ("evict", "merge", "merge_l2", "spill")

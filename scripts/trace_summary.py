@@ -11,11 +11,17 @@ column as ``stale-dropped/tier-resident-fps`` — and totals. On
 attribution-mode traces (``attribution=True`` runs emit ``.pipeline``
 spans) an ``attribution`` table follows: one row per span group with the
 per-phase ms share of wave wall (device/host_probe/evict/checkpoint/
-compile/gap). Use ``scripts/storage_report.py`` for the tier-level view
-(evictions, merges, spills, per-tier probe latency) and
-``scripts/gap_report.py`` for the full phase ledger + overlap-headroom
-estimate. ``--chrome-out`` additionally writes the Chrome trace-event
-export (load it in https://ui.perfetto.dev or chrome://tracing).
+compile/gap). On coverage-recording traces (``coverage=True`` device
+runs; host engines always-on) a ``coverage`` table follows: cumulative
+evaluated/terminal counts, action coverage with the dead-action tally,
+revisit rate, and sometimes-witness counts per backend. Use
+``scripts/storage_report.py`` for the tier-level view (evictions,
+merges, spills, per-tier probe latency), ``scripts/gap_report.py`` for
+the full phase ledger + overlap-headroom estimate, and
+``scripts/coverage_report.py`` for the full cartography + the CI
+vacuity gate. ``--chrome-out`` additionally writes the Chrome
+trace-event export (load it in https://ui.perfetto.dev or
+chrome://tracing).
 
 Stdlib-only on the read path (json + argparse): trace files outlive the
 runs that wrote them and must stay inspectable on boxes without jax.
@@ -184,6 +190,56 @@ def print_attribution(groups, out=sys.stdout):
         )
 
 
+def coverage_rows(events):
+    """Per-prefix coverage aggregates from the cumulative ``.coverage``
+    spans (coverage-mode device runs / always-on host engines): the LAST
+    span per name wins — every span carries run-so-far totals."""
+    rows = {}
+    for ev in events:
+        if ev.get("ph") != "X":
+            continue
+        name = ev.get("name", "")
+        if not name.endswith(".coverage"):
+            continue
+        args = ev.get("args") or {}
+        if "actions_fired" not in args:
+            continue
+        rows[name[: -len(".coverage")]] = dict(args)
+    return rows
+
+
+def print_coverage(rows, out=sys.stdout):
+    """The coverage table: per prefix, evaluated/terminal counts, action
+    coverage (dead actions flagged), revisit rate, and the
+    sometimes-witness tally — the vacuity quick-look
+    (``scripts/coverage_report.py`` renders the full cartography)."""
+    out.write("\ncoverage (cumulative, per backend):\n")
+    header = (
+        f"{'prefix':<14} {'evaluated':>10} {'terminals':>9} "
+        f"{'actions':>9} {'dead':>5} {'revisit%':>9} {'sometimes':>10}"
+    )
+    out.write(header + "\n")
+    out.write("-" * len(header) + "\n")
+    for prefix in sorted(rows):
+        a = rows[prefix]
+        total = a.get("actions_total")
+        actions = (
+            f"{a.get('actions_fired', 0)}/{total}"
+            if total is not None
+            else str(a.get("actions_fired", 0))
+        )
+        sometimes = (
+            f"{a.get('sometimes_witnessed', 0)}/{a.get('sometimes_total', 0)}"
+        )
+        out.write(
+            f"{prefix:<14} {a.get('evaluated', 0):>10} "
+            f"{a.get('terminals', 0):>9} {actions:>9} "
+            f"{str(a.get('dead_actions', '')):>5} "
+            f"{100.0 * a.get('revisit_rate', 0.0):>9.1f} "
+            f"{sometimes:>10}\n"
+        )
+
+
 def top_spans(events, n):
     """The n slowest complete spans, any name — where the wall time went
     (wave, drain, table_grow, storage evict/merge/probe alike)."""
@@ -246,6 +302,9 @@ def main(argv=None):
     attribution = attribution_rows(events)
     if attribution:
         print_attribution(attribution)
+    coverage = coverage_rows(events)
+    if coverage:
+        print_coverage(coverage)
     if args.top:
         print()
         print_top(top_spans(events, args.top))

@@ -37,6 +37,14 @@ class Checker(Generic[State, Action]):
     # device/host boundary to attribute and leave the class default.
     _attr = None
 
+    # Coverage ledger (telemetry/coverage.py): opt-in on the device
+    # checkers (coverage=True — the reductions ride the wave jits),
+    # always-on for the host engines (their per-state Python loop dwarfs
+    # the per-block dict merges).
+    _cov = None
+    _cov_layout = None
+    _cov_antecedents = None
+
     # -- abstract surface --------------------------------------------------
 
     def model(self):
@@ -140,6 +148,67 @@ class Checker(Generic[State, Action]):
         ``attribution=True`` (the device checkers are; host engines have
         no device/host boundary to attribute)."""
         return self._attr.report() if self._attr is not None else None
+
+    # -- coverage ledger (device checkers opt in; host engines always-on) ---
+
+    def _init_coverage(self, prefix: str, coverage, action_count: int,
+                       symmetry: bool = False) -> None:
+        """Installs the coverage ledger + device reduction layout when
+        requested. Falsy leaves coverage off (the class default) and the
+        wave jits trace exactly as before — the off-mode cost is zero."""
+        if not coverage:
+            return
+        from ..telemetry.coverage import (
+            CoverageLedger,
+            DeviceCoverage,
+            coverage_action_labels,
+        )
+
+        model = self._model
+        props = self._properties
+        self._cov = CoverageLedger(
+            prefix,
+            props,
+            action_labels=coverage_action_labels(model, action_count),
+            symmetry=symmetry,
+            tracer=self._tracer,
+        )
+        self._cov_layout = DeviceCoverage(
+            action_count, len(props), symmetry=symmetry
+        )
+        try:
+            ants = list(model.packed_antecedents())
+        except Exception:  # noqa: BLE001 - optional hook
+            ants = [None] * len(props)
+        if len(ants) != len(props):
+            raise ValueError(
+                "packed_antecedents() must align 1:1 with properties(): "
+                f"{len(ants)} != {len(props)}"
+            )
+        self._cov_antecedents = ants
+
+    def _finalize_coverage(self, discovered) -> None:
+        """Run-end ledger finalize (summary instant + vacuity verdict);
+        never raises — it must not mask a real worker error."""
+        if self._cov is None:
+            return
+        try:
+            self._cov.finalize(discovered=discovered)
+        except Exception:  # noqa: BLE001
+            pass
+
+    @property
+    def coverage(self):
+        """The ``CoverageLedger``, or None when coverage is off."""
+        return self._cov
+
+    def coverage_report(self) -> Optional[dict]:
+        """The state-space cartography (``telemetry/coverage.py``):
+        per-action fire/fresh counts with dead-action detection,
+        per-property exercise counts (vacuity), and shape statistics.
+        None unless the backend records coverage (device checkers need
+        ``coverage=True``; host engines are always-on)."""
+        return self._cov.report() if self._cov is not None else None
 
     def serve_monitor(self, port: int = 0, **kwargs):
         """Starts the live in-process monitor HTTP server for this run
@@ -275,6 +344,22 @@ class Checker(Generic[State, Action]):
             for name, path in self.discoveries().items()
         }
         reporter.report_discoveries(discoveries)
+        # Run-end vacuity visibility (upstream-parity, see MIGRATING.md):
+        # a sometimes/eventually property with no discovery is a silent
+        # pass unless the reporter says so — even without the coverage
+        # ledger. Only once checking actually completed: an early-exit
+        # run proves nothing about undiscoverability.
+        if self.is_done():
+            undiscovered = [
+                p
+                for p in self.model().properties()
+                if p.name not in discoveries
+                and p.expectation in (
+                    Expectation.SOMETIMES, Expectation.EVENTUALLY
+                )
+            ]
+            if undiscovered:
+                reporter.report_undiscovered(undiscovered)
         return self
 
     def discovery(self, name: str) -> Optional[Path]:

@@ -18,6 +18,7 @@ from ..core.fingerprint import Fingerprint, fingerprint
 from ..core.model import Expectation
 from ..core.path import Path
 from ..telemetry import BlockInstruments, get_tracer
+from ..telemetry.coverage import BlockCoverage, CoverageLedger
 from .base import Checker
 from .job_market import JobBroker
 
@@ -62,6 +63,11 @@ class BfsChecker(Checker):
         # the always-on layer stays off the per-state hot loop.
         self._tracer = get_tracer()
         self._bi = BlockInstruments("bfs")
+        # Coverage ledger (telemetry/coverage.py): always-on for the
+        # host engines — per-block dict merges are noise next to the
+        # per-state Python expansion loop.
+        self._cov = CoverageLedger("bfs", properties, tracer=self._tracer)
+        self._cov.record_seed(len(self._generated))
         self._job_broker: JobBroker[Job] = JobBroker(thread_count)
         self._job_broker.push(pending)
         self._worker_error: Optional[BaseException] = None
@@ -90,6 +96,7 @@ class BfsChecker(Checker):
                     self._worker_error = e
             finally:
                 self._job_broker.close()
+                self._finalize_coverage(set(self._discoveries))
 
         for t in range(thread_count):
             h = threading.Thread(
@@ -110,6 +117,7 @@ class BfsChecker(Checker):
         block_max_depth = self._max_depth
         block_span = self._tracer.span("bfs.block")
         block_span.__enter__()
+        bc = BlockCoverage(self._cov, model)
         try:
             while max_count > 0 and pending:
                 max_count -= 1
@@ -122,6 +130,7 @@ class BfsChecker(Checker):
                     and depth >= self._target_max_depth
                 ):
                     continue
+                bc.evaluated += 1
                 if visitor is not None:
                     visitor.visit(
                         model, reconstruct_path(model, generated, state_fp)
@@ -138,9 +147,15 @@ class BfsChecker(Checker):
                             discoveries[prop.name] = state_fp
                         else:
                             is_awaiting_discoveries = True
+                        # Exercise: antecedent-true states (vacuity), or
+                        # every evaluated state without one.
+                        ant = prop.antecedent
+                        if ant is None or ant(model, state):
+                            bc.exercise(i)
                     elif prop.expectation == Expectation.SOMETIMES:
                         if prop.condition(model, state):
                             discoveries[prop.name] = state_fp
+                            bc.exercise(i)
                         else:
                             is_awaiting_discoveries = True
                     else:
@@ -149,11 +164,14 @@ class BfsChecker(Checker):
                         is_awaiting_discoveries = True
                         if prop.condition(model, state):
                             ebits = ebits - {i}
+                        if i not in ebits:
+                            bc.exercise(i)
                 if not is_awaiting_discoveries:
                     return
 
                 # Expand.
                 is_terminal = True
+                succ = 0
                 actions.clear()
                 model.actions(state, actions)
                 for action in actions:
@@ -163,17 +181,23 @@ class BfsChecker(Checker):
                     if not model.within_boundary(next_state):
                         continue
                     generated_count += 1
+                    succ += 1
                     next_fp = fingerprint(next_state)
                     # NOTE (parity): ebits are deliberately NOT part of the
                     # fingerprint, reproducing the reference's documented
                     # eventually-property false-negative on DAG joins/cycles.
                     if next_fp in generated:
                         is_terminal = False
+                        bc.action(action, False)
                         continue
                     generated[next_fp] = state_fp
                     is_terminal = False
+                    bc.action(action, True)
+                    bc.depth[depth + 1] = bc.depth.get(depth + 1, 0) + 1
                     pending.appendleft((next_state, next_fp, ebits, depth + 1))
+                bc.succ[succ] = bc.succ.get(succ, 0) + 1
                 if is_terminal:
+                    bc.terminals += 1
                     for i, prop in enumerate(properties):
                         # Insert-if-vacant: once a property has a discovery its
                         # ebit is no longer cleared during evaluation, so a
@@ -197,6 +221,7 @@ class BfsChecker(Checker):
                 unique_total=len(generated),
                 pending=len(pending),
             )
+            bc.flush(max_depth=block_max_depth)
 
     # -- Checker surface ---------------------------------------------------
 

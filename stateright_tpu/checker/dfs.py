@@ -19,6 +19,7 @@ from ..core.fingerprint import Fingerprint, fingerprint
 from ..core.model import Expectation
 from ..core.path import Path
 from ..telemetry import BlockInstruments, get_tracer
+from ..telemetry.coverage import BlockCoverage, CoverageLedger
 from .base import Checker
 from .job_market import JobBroker
 
@@ -63,6 +64,12 @@ class DfsChecker(Checker):
         # Per-block telemetry (see the matching note in bfs.py).
         self._tracer = get_tracer()
         self._bi = BlockInstruments("dfs")
+        # Always-on coverage ledger (see the matching note in bfs.py).
+        self._cov = CoverageLedger(
+            "dfs", properties, symmetry=symmetry is not None,
+            tracer=self._tracer,
+        )
+        self._cov.record_seed(len(self._generated))
         self._job_broker: JobBroker[Job] = JobBroker(thread_count)
         self._job_broker.push(pending)
         self._worker_error: Optional[BaseException] = None
@@ -92,6 +99,7 @@ class DfsChecker(Checker):
                     self._worker_error = e
             finally:
                 self._job_broker.close()
+                self._finalize_coverage(set(self._discoveries))
 
         for t in range(thread_count):
             h = threading.Thread(
@@ -113,6 +121,7 @@ class DfsChecker(Checker):
         block_max_depth = self._max_depth
         block_span = self._tracer.span("dfs.block")
         block_span.__enter__()
+        bc = BlockCoverage(self._cov, model)
         try:
             while max_count > 0 and pending:
                 max_count -= 1
@@ -125,6 +134,7 @@ class DfsChecker(Checker):
                     and depth >= self._target_max_depth
                 ):
                     continue
+                bc.evaluated += 1
                 if visitor is not None:
                     visitor.visit(
                         model, Path.from_fingerprints(model, fingerprints)
@@ -139,19 +149,26 @@ class DfsChecker(Checker):
                             discoveries[prop.name] = list(fingerprints)
                         else:
                             is_awaiting_discoveries = True
+                        ant = prop.antecedent
+                        if ant is None or ant(model, state):
+                            bc.exercise(i)
                     elif prop.expectation == Expectation.SOMETIMES:
                         if prop.condition(model, state):
                             discoveries[prop.name] = list(fingerprints)
+                            bc.exercise(i)
                         else:
                             is_awaiting_discoveries = True
                     else:  # EVENTUALLY
                         is_awaiting_discoveries = True
                         if prop.condition(model, state):
                             ebits = ebits - {i}
+                        if i not in ebits:
+                            bc.exercise(i)
                 if not is_awaiting_discoveries:
                     return
 
                 is_terminal = True
+                succ = 0
                 actions.clear()
                 model.actions(state, actions)
                 for action in actions:
@@ -161,6 +178,7 @@ class DfsChecker(Checker):
                     if not model.within_boundary(next_state):
                         continue
                     generated_count += 1
+                    succ += 1
                     if symmetry is not None:
                         # Dedup on the canonical member of the equivalence
                         # class, but continue the path with the
@@ -169,6 +187,7 @@ class DfsChecker(Checker):
                         representative_fp = fingerprint(symmetry(next_state))
                         if representative_fp in generated:
                             is_terminal = False
+                            bc.action(action, False)
                             continue
                         generated.add(representative_fp)
                         next_fp = fingerprint(next_state)
@@ -176,13 +195,18 @@ class DfsChecker(Checker):
                         next_fp = fingerprint(next_state)
                         if next_fp in generated:
                             is_terminal = False
+                            bc.action(action, False)
                             continue
                         generated.add(next_fp)
                     is_terminal = False
+                    bc.action(action, True)
+                    bc.depth[depth + 1] = bc.depth.get(depth + 1, 0) + 1
                     pending.append(
                         (next_state, fingerprints + [next_fp], ebits, depth + 1)
                     )
+                bc.succ[succ] = bc.succ.get(succ, 0) + 1
                 if is_terminal:
+                    bc.terminals += 1
                     for i, prop in enumerate(properties):
                         # Insert-if-vacant: a stale ebit (clearing stops once
                         # the property is discovered) must not overwrite the
@@ -203,6 +227,7 @@ class DfsChecker(Checker):
                 unique_total=len(generated),
                 pending=len(pending),
             )
+            bc.flush(max_depth=block_max_depth)
 
     # -- Checker surface ---------------------------------------------------
 

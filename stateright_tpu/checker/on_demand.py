@@ -20,6 +20,7 @@ from ..core.fingerprint import Fingerprint, fingerprint
 from ..core.model import Expectation
 from ..core.path import Path
 from ..telemetry import BlockInstruments, get_tracer
+from ..telemetry.coverage import BlockCoverage, CoverageLedger
 from .base import Checker
 from .bfs import reconstruct_path
 from .job_market import JobBroker
@@ -61,6 +62,12 @@ class OnDemandChecker(Checker):
         # Per-block telemetry (see the matching note in bfs.py).
         self._tracer = get_tracer()
         self._bi = BlockInstruments("on_demand")
+        # Always-on coverage ledger (see the matching note in bfs.py) —
+        # this is what feeds the Explorer's coverage panel.
+        self._cov = CoverageLedger(
+            "on_demand", properties, tracer=self._tracer
+        )
+        self._cov.record_seed(len(self._generated))
         self._job_broker: JobBroker[Job] = JobBroker(thread_count)
         self._job_broker.push(pending)
         self._worker_error: Optional[BaseException] = None
@@ -127,6 +134,7 @@ class OnDemandChecker(Checker):
                     self._worker_error = e
             finally:
                 self._job_broker.close()
+                self._finalize_coverage(set(self._discoveries))
 
         for t in range(thread_count):
             control: "queue.Queue" = queue.Queue()
@@ -172,11 +180,13 @@ class OnDemandChecker(Checker):
         block_max_depth = self._max_depth
         block_span = self._tracer.span("on_demand.block")
         block_span.__enter__()
+        bc = BlockCoverage(self._cov, model)
         try:
             while local:
                 state, state_fp, ebits, depth = local.pop()
                 if depth > block_max_depth:
                     block_max_depth = depth
+                bc.evaluated += 1
                 if visitor is not None:
                     visitor.visit(
                         model, reconstruct_path(model, generated, state_fp)
@@ -191,19 +201,26 @@ class OnDemandChecker(Checker):
                             discoveries[prop.name] = state_fp
                         else:
                             is_awaiting_discoveries = True
+                        ant = prop.antecedent
+                        if ant is None or ant(model, state):
+                            bc.exercise(i)
                     elif prop.expectation == Expectation.SOMETIMES:
                         if prop.condition(model, state):
                             discoveries[prop.name] = state_fp
+                            bc.exercise(i)
                         else:
                             is_awaiting_discoveries = True
                     else:  # EVENTUALLY
                         is_awaiting_discoveries = True
                         if prop.condition(model, state):
                             ebits = ebits - {i}
+                        if i not in ebits:
+                            bc.exercise(i)
                 if not is_awaiting_discoveries:
                     return
 
                 is_terminal = True
+                succ = 0
                 actions: List = []
                 model.actions(state, actions)
                 for action in actions:
@@ -213,14 +230,20 @@ class OnDemandChecker(Checker):
                     if not model.within_boundary(next_state):
                         continue
                     generated_count += 1
+                    succ += 1
                     next_fp = fingerprint(next_state)
                     if next_fp in generated:
                         is_terminal = False
+                        bc.action(action, False)
                         continue
                     generated[next_fp] = state_fp
                     is_terminal = False
+                    bc.action(action, True)
+                    bc.depth[depth + 1] = bc.depth.get(depth + 1, 0) + 1
                     pending.appendleft((next_state, next_fp, ebits, depth + 1))
+                bc.succ[succ] = bc.succ.get(succ, 0) + 1
                 if is_terminal:
+                    bc.terminals += 1
                     for i, prop in enumerate(properties):
                         # Insert-if-vacant: once a property has a discovery its
                         # ebit is no longer cleared during evaluation, so a
@@ -244,6 +267,7 @@ class OnDemandChecker(Checker):
                 unique_total=len(generated),
                 pending=len(targetted) + len(pending),
             )
+            bc.flush(max_depth=block_max_depth)
 
     # -- Checker surface ---------------------------------------------------
 

@@ -470,6 +470,7 @@ class TpuBfsChecker(Checker):
         host_budget_mib=None,
         spill_dir=None,
         attribution=False,
+        coverage=False,
     ):
         model = options.model
         if not isinstance(model, BatchableModel):
@@ -709,6 +710,16 @@ class TpuBfsChecker(Checker):
             self._use_fps = True
         else:
             self._use_fps = False
+        # State-space cartography (opt-in, telemetry/coverage.py): the
+        # per-action/per-property/shape reductions ride INSIDE the wave
+        # jit (one extra int32 vector per existing host exit; the deep
+        # drain accumulates it in its carry), so coverage=True runs stay
+        # bit-identical and coverage=False traces no extra ops at all.
+        # Must precede the jit construction below — _wave reads _cov at
+        # trace time.
+        self._init_coverage(
+            "tpu_bfs", coverage, self._A, symmetry=self._symmetry_enabled
+        )
         # Buffer donation kills the per-call copy of the big operands
         # (hash table, pool ring): every donated argnum below is audited —
         # the caller never touches the donated buffer after the call
@@ -947,6 +958,51 @@ class TpuBfsChecker(Checker):
             out["prop_hit"] = jnp.stack(hits)
             out["prop_hi"] = jnp.stack(fhis)
             out["prop_lo"] = jnp.stack(flos)
+        if self._cov is not None:
+            # Coverage reductions (telemetry/coverage.py) fused into the
+            # wave: per-action fired/fresh, per-property exercise,
+            # terminal/successor/depth shape stats — one extra int32
+            # vector per wave, drained at the existing host exits. None
+            # of the wave's own outputs depend on these, so results are
+            # bit-identical with coverage off.
+            exercised = []
+            for pi, p in enumerate(self._properties):
+                if p.expectation == Expectation.ALWAYS:
+                    ant = self._cov_antecedents[pi]
+                    exercised.append(
+                        eval_mask & jax.vmap(ant)(states)
+                        if ant is not None
+                        else eval_mask
+                    )
+                elif p.expectation == Expectation.SOMETIMES:
+                    exercised.append(eval_mask & cond_vals[pi])
+                else:  # EVENTUALLY: met == the unmet bit already cleared
+                    eb = self._ebit[pi]
+                    exercised.append(
+                        eval_mask
+                        & (((ebits_after >> jnp.uint32(eb)) & 1) == 0)
+                    )
+            uniq_fp = uniq_key = None
+            if self._symmetry_enabled:
+                # Orbit compression: in-wave distinct plain fps over
+                # distinct orbit keys (two extra sorts, coverage mode
+                # only).
+                uniq_fp = self._cov_layout.count_distinct(
+                    chi, clo, cvalid_flat
+                )
+                uniq_key = self._cov_layout.count_distinct(
+                    khi, klo, cvalid_flat
+                )
+            out["cov"] = self._cov_layout.wave_reduce(
+                eval_mask=eval_mask,
+                cvalid=cvalid,
+                fresh=fresh,
+                lane_action=sidx % A,
+                new_depth=depth[sidx // A] + 1,
+                exercised=exercised,
+                uniq_fp=uniq_fp,
+                uniq_key=uniq_key,
+            )
         # One consolidated scalar vector: each np.asarray() pull through the
         # device tunnel costs a round trip, so the host loop reads counters
         # (and property-hit flags) in a single transfer per wave.
@@ -1118,6 +1174,13 @@ class TpuBfsChecker(Checker):
             # numerator; the denominator is waves × width, host-side).
             "live_sum": frontier0["mask"].sum(dtype=jnp.int32),
         }
+        if self._cov is not None:
+            # Consumed waves' coverage vectors accumulate in the carry
+            # (all slices are additive counts); the final unconsumed
+            # wave's vector rides out["cov"] and is consumed host-side.
+            carry["cov_acc"] = jnp.zeros(
+                (self._cov_layout.size,), jnp.int32
+            )
 
         def cond(c):
             o = c["out"]
@@ -1198,7 +1261,7 @@ class TpuBfsChecker(Checker):
                     },
                 )
             frontier, head, count = self._pool_take(pool, c["head"], count, F)
-            return {
+            nxt = {
                 "pool": pool,
                 "head": head,
                 "count": count,
@@ -1214,6 +1277,9 @@ class TpuBfsChecker(Checker):
                 "live_sum": c["live_sum"]
                 + frontier["mask"].sum(dtype=jnp.int32),
             }
+            if self._cov is not None:
+                nxt["cov_acc"] = c["cov_acc"] + o["cov"]
+            return nxt
 
         res = jax.lax.while_loop(cond, body, carry)
         # One consolidated transfer for the consumed-wave bookkeeping, and
@@ -1285,6 +1351,7 @@ class TpuBfsChecker(Checker):
             self._error = e
             self._abort_attribution()
         finally:
+            self._finalize_coverage(set(self._discoveries_fp))
             self._done_event.set()
 
     def _grow_table(self, table, min_capacity):
@@ -1544,6 +1611,16 @@ class TpuBfsChecker(Checker):
             # max_depth, any_prop_hit?]; per-property fingerprints are
             # pulled only on a hit.
             stats = np.asarray(wave["stats"])
+            if self._cov is not None:
+                # One extra (small) pull per wave in coverage mode; a
+                # table-growth retry re-expands the same frontier, so
+                # only the fresh-based slices accumulate then.
+                self._cov.consume_device(
+                    np.asarray(wave["cov"]),
+                    self._cov_layout,
+                    first_attempt=(attempt == 0),
+                    max_depth=int(stats[3]),
+                )
             if attempt == 0:
                 generated = int(stats[0])
                 self._state_count += generated
@@ -1607,6 +1684,8 @@ class TpuBfsChecker(Checker):
                     span, chunk["hi"].shape[0], generated, wave_new,
                     stale=stale_total, pending=pending,
                 )
+                if self._cov is not None:
+                    self._cov.emit_wave_span()
                 return table, wave_new
             if self._max_capacity is not None and attempt >= 8:
                 # Pathological probe-window cluster: the wave overflows
@@ -1921,6 +2000,14 @@ class TpuBfsChecker(Checker):
                     )
                 pool, head, count = res["pool"], res["head"], res["count"]
                 pool_count = int(dstats[5])
+                if self._cov is not None:
+                    # The drain's consumed-wave coverage aggregate (the
+                    # final unconsumed wave rides _consume_wave below).
+                    self._cov.consume_device(
+                        np.asarray(res["cov_acc"]),
+                        self._cov_layout,
+                        max_depth=int(dstats[3]),
+                    )
                 if log_n:
                     # The whole drain's parent-fp stream in one transfer.
                     pack = np.asarray(res["log_pack"][:, :log_n])
@@ -2021,6 +2108,8 @@ class TpuBfsChecker(Checker):
         # the checker's (init states never flow through a wave).
         self._wi.generated.inc(self._state_count)
         self._wi.unique.inc(self._unique_count)
+        if self._cov is not None:
+            self._cov.record_seed(self._unique_count)
         hi = np.asarray(out["hi"])
         lo = np.asarray(out["lo"])
         valid = np.asarray(out["valid"])

@@ -122,6 +122,24 @@ class BatchableModel:
         """
         raise NotImplementedError
 
+    def packed_antecedents(self):
+        """OPTIONAL traceable antecedent predicates aligned 1:1 with
+        ``properties()`` (``None`` entries for properties without one) —
+        the device analog of ``Property.antecedent``. The coverage ledger
+        (``telemetry/coverage.py``) counts antecedent-true frontier
+        states per ``always`` property so vacuous passes (the guard of an
+        implication-shaped invariant never firing) are detectable on the
+        device path too. Never consulted outside coverage mode."""
+        return [None] * len(self.packed_conditions())
+
+    def packed_action_labels(self) -> List[str]:
+        """OPTIONAL human-readable labels for the dense action ids
+        ``0..packed_action_count()`` — the coverage ledger's per-action
+        axis (``<prefix>.coverage.action_fired.<label>`` counters, the
+        Explorer's per-action bar view, ``scripts/coverage_report.py``'s
+        action table). Defaults to ``action_<id>``."""
+        return [f"action_{i}" for i in range(self.packed_action_count())]
+
     def packed_within_boundary(self, state: PackedState) -> jax.Array:
         """Traceable analog of ``within_boundary`` (scalar bool)."""
         import jax.numpy as jnp

@@ -32,15 +32,27 @@ class Property(Generic[State]):
       documented false-negative on cycles/DAG joins,
       ``/root/reference/src/lib.rs:278-287`` and ``src/checker/bfs.rs:285-305``);
       the checker seeks a counterexample path ending in a terminal state.
+
+    ``antecedent`` (optional, ``always`` only) declares the guard of an
+    implication-shaped invariant (``antecedent => consequent``): the
+    coverage ledger counts the states where it held, so a run whose
+    antecedent never fired is reported as a *vacuous* pass instead of a
+    silent green (TLC's coverage statistics make the same distinction).
+    It never changes checking semantics — only observability.
     """
 
     expectation: Expectation
     name: str
     condition: Callable[[Any, Any], bool]
+    antecedent: Optional[Callable[[Any, Any], bool]] = None
 
     @staticmethod
-    def always(name: str, condition: Callable[[Any, Any], bool]) -> "Property":
-        return Property(Expectation.ALWAYS, name, condition)
+    def always(
+        name: str,
+        condition: Callable[[Any, Any], bool],
+        antecedent: Optional[Callable[[Any, Any], bool]] = None,
+    ) -> "Property":
+        return Property(Expectation.ALWAYS, name, condition, antecedent)
 
     @staticmethod
     def eventually(name: str, condition: Callable[[Any, Any], bool]) -> "Property":

@@ -172,6 +172,21 @@ class TwoPhaseSys(Model, BatchableModel):
     def packed_action_count(self) -> int:
         return 2 + 5 * self.rm_count
 
+    def packed_action_labels(self):
+        # Dense-id labels mirroring packed_step's dispatch (aid 0/1 are
+        # the TM actions, then 5 per RM) — the coverage ledger's
+        # per-action axis reads like the host actions() names.
+        labels = ["TmCommit", "TmAbort"]
+        for rm in range(self.rm_count):
+            labels += [
+                f"TmRcvPrepared_{rm}",
+                f"RmPrepare_{rm}",
+                f"RmChooseToAbort_{rm}",
+                f"RmRcvCommitMsg_{rm}",
+                f"RmRcvAbortMsg_{rm}",
+            ]
+        return labels
+
     def packed_init_states(self):
         import jax.numpy as jnp
 

@@ -138,6 +138,7 @@ class ShardedTpuBfsChecker(Checker):
         host_budget_mib=None,
         spill_dir=None,
         attribution=False,
+        coverage=False,
     ):
         model = options.model
         if not isinstance(model, BatchableModel):
@@ -389,6 +390,14 @@ class ShardedTpuBfsChecker(Checker):
         # ``sharded_bfs`` — results stay bit-identical (fences change
         # pacing only).
         self._init_attribution("sharded_bfs", attribution)
+        # State-space cartography (opt-in, telemetry/coverage.py): the
+        # same fused reductions as TpuBfsChecker, computed per shard
+        # inside the wave/drain shard_maps and summed across the mesh at
+        # the existing host exits. coverage=False traces no extra ops.
+        self._init_coverage(
+            "sharded_bfs", coverage, self._A,
+            symmetry=self._symmetry_enabled,
+        )
         self.donation_enabled = True
 
         self._handles = [
@@ -499,6 +508,8 @@ class ShardedTpuBfsChecker(Checker):
         if self._properties:
             for k in ("prop_hit", "prop_hi", "prop_lo"):
                 wrapped[k] = out[k][None]
+        if self._cov is not None:
+            wrapped["cov"] = out["cov"][None]
         return wrapped
 
     def _wave_core(self, table_loc, states, hi, lo, ebits, depth, mask, depth_cap):
@@ -596,6 +607,48 @@ class ShardedTpuBfsChecker(Checker):
             out["prop_hit"] = jnp.stack(hits)
             out["prop_hi"] = jnp.stack(fhis)
             out["prop_lo"] = jnp.stack(flos)
+        if self._cov is not None:
+            # Per-shard coverage reduction (telemetry/coverage.py): the
+            # host sums the per-device vectors at its existing exits.
+            # ``fresh`` marks this shard's GENERATOR-side claim winners,
+            # so per-action fresh attribution stays exact across the
+            # mesh exchange.
+            exercised = []
+            for pi, p in enumerate(self._properties):
+                if p.expectation == Expectation.ALWAYS:
+                    ant = self._cov_antecedents[pi]
+                    exercised.append(
+                        eval_mask & jax.vmap(ant)(states)
+                        if ant is not None
+                        else eval_mask
+                    )
+                elif p.expectation == Expectation.SOMETIMES:
+                    exercised.append(eval_mask & cond_vals[pi])
+                else:
+                    eb = self._ebit[pi]
+                    exercised.append(
+                        eval_mask
+                        & (((ebits_after >> jnp.uint32(eb)) & 1) == 0)
+                    )
+            uniq_fp = uniq_key = None
+            if self._symmetry_enabled:
+                uniq_fp = self._cov_layout.count_distinct(
+                    chi, clo, cvalid_flat
+                )
+                uniq_key = self._cov_layout.count_distinct(
+                    khi, klo, cvalid_flat
+                )
+            lanes_b = jnp.arange(B, dtype=jnp.int32)
+            out["cov"] = self._cov_layout.wave_reduce(
+                eval_mask=eval_mask,
+                cvalid=cvalid,
+                fresh=fresh,
+                lane_action=lanes_b % A,
+                new_depth=depth[lanes_b // A] + 1,
+                exercised=exercised,
+                uniq_fp=uniq_fp,
+                uniq_key=uniq_key,
+            )
         return out
 
     def _rehash_local(self, old_table, new_table):
@@ -774,6 +827,10 @@ class ShardedTpuBfsChecker(Checker):
                 jnp.int32(0), undiscovered,
             ),
         }
+        if self._cov is not None:
+            carry["cov_acc"] = jnp.zeros(
+                (self._cov_layout.size,), jnp.int32
+            )
 
         def cond(c):
             return c["go"]
@@ -816,7 +873,7 @@ class ShardedTpuBfsChecker(Checker):
             budget = c["budget"] - jax.lax.psum(n_new, "fp")
             waves = c["waves"] + 1
             gen_acc = c["generated"] + o["generated"]
-            return {
+            nxt = {
                 "pool": pool,
                 "head": head,
                 "count": count,
@@ -833,6 +890,9 @@ class ShardedTpuBfsChecker(Checker):
                     out, count, log_n, budget, waves, gen_acc, undiscovered
                 ),
             }
+            if self._cov is not None:
+                nxt["cov_acc"] = c["cov_acc"] + o["cov"]
+            return nxt
 
         res = jax.lax.while_loop(cond, body, carry)
         o = res["out"]
@@ -868,6 +928,12 @@ class ShardedTpuBfsChecker(Checker):
         if self._symmetry_enabled:
             out["final"]["new_khi"] = o["new_khi"]
             out["final"]["new_klo"] = o["new_klo"]
+        if self._cov is not None:
+            # Consumed waves' accumulator plus the final (unconsumed)
+            # wave: the final wave's expansion is complete device-side —
+            # only its fresh rows' bookkeeping happens in _consume_final,
+            # and an overflow retry there records fresh-based slices only.
+            out["cov_acc"] = (res["cov_acc"] + o["cov"])[None]
         cols = ["child_hi", "child_lo", "parent_hi", "parent_lo"]
         if self._symmetry_enabled:
             cols += ["key_hi", "key_lo"]
@@ -889,6 +955,7 @@ class ShardedTpuBfsChecker(Checker):
             self._error = e
             self._abort_attribution()
         finally:
+            self._finalize_coverage(set(self._discoveries_fp))
             self._done_event.set()
 
     def _new_table(self):
@@ -1194,6 +1261,18 @@ class ShardedTpuBfsChecker(Checker):
                                             break
                             if self._visitor is not None:
                                 self._visit_chunk(chunk)
+                        if self._cov is not None:
+                            # Mesh-summed coverage vector; a growth retry
+                            # re-expands the same chunk, so only the
+                            # fresh-based slices accumulate then.
+                            self._cov.consume_device(
+                                np.asarray(
+                                    self._pull(wave["cov"])
+                                ).sum(axis=0),
+                                self._cov_layout,
+                                first_attempt=(attempt == 0),
+                                max_depth=self._max_depth,
+                            )
                         wave_new += self._harvest(wave)
                         if not int(self._pull(wave["overflow"]).sum()):
                             break
@@ -1218,6 +1297,8 @@ class ShardedTpuBfsChecker(Checker):
                         compaction_ratio=(got / width if bucket else None),
                         live_lanes=got,
                     )
+                    if self._cov is not None:
+                        self._cov.emit_wave_span()
                 if self.warmup_seconds is None:
                     self.warmup_seconds = time.perf_counter() - self._t_start
                     self._wi.warmup.set(self.warmup_seconds)
@@ -1417,6 +1498,16 @@ class ShardedTpuBfsChecker(Checker):
                     )
                 pool, head, count = res["pool"], res["head"], res["count"]
                 ring_est = int(dstats[:, 5].max())
+                if self._cov is not None:
+                    # Every drain wave (final included — see
+                    # _deep_drain_local's cov_acc note), mesh-summed.
+                    self._cov.consume_device(
+                        np.asarray(
+                            self._pull(res["cov_acc"])
+                        ).sum(axis=0),
+                        self._cov_layout,
+                        max_depth=self._max_depth,
+                    )
                 # The whole drain's parent-fp stream: one (n, 6, Ll) transfer,
                 # sliced per device by its log_n.
                 max_log = int(dstats[:, 0].max())
@@ -1523,6 +1614,15 @@ class ShardedTpuBfsChecker(Checker):
                 # the wave path.
                 wave = self._call_wave(table, fr, depth_cap)
                 table = wave["table"]
+                if self._cov is not None:
+                    # Retry of the drain's final frontier: its eval-based
+                    # slices already rode cov_acc; only the newly-claimed
+                    # fresh lanes accumulate.
+                    self._cov.consume_device(
+                        np.asarray(self._pull(wave["cov"])).sum(axis=0),
+                        self._cov_layout,
+                        first_attempt=False,
+                    )
                 harvested = self._harvest(wave)
                 self._wi.unique.inc(harvested)
                 retry_new += harvested
@@ -1547,6 +1647,8 @@ class ShardedTpuBfsChecker(Checker):
                 waves=0,
                 live_lanes=ring_est,
             )
+        if self._cov is not None:
+            self._cov.emit_wave_span()
         return table, pool, head, count, ring_est
 
     def _checkpoint_rings(self, pool, head, count):
@@ -1614,6 +1716,8 @@ class ShardedTpuBfsChecker(Checker):
         # Seed the cumulative counters too (init states skip the waves).
         self._wi.generated.inc(self._state_count)
         self._wi.unique.inc(self._unique_count)
+        if self._cov is not None:
+            self._cov.record_seed(self._unique_count)
         child64 = fp64_pairs(hi, lo)
         self._wave_log.append((child64[fresh], np.zeros((fresh.sum(),), np.uint64)))
         if self._symmetry_enabled:

@@ -35,6 +35,13 @@ class Reporter:
     def report_discoveries(self, discoveries: Dict[str, ReportDiscovery]) -> None:
         raise NotImplementedError
 
+    def report_undiscovered(self, properties) -> None:
+        """Called once at run end (completed runs only) with the
+        sometimes/eventually properties that have NO discovery, so a
+        vacuous pass — a ``sometimes`` never witnessed — is visible even
+        without the coverage ledger (upstream-parity: see MIGRATING.md).
+        Default no-op keeps existing reporters source-compatible."""
+
     def delay(self) -> float:
         """Seconds between progress reports."""
         return 1.0
@@ -63,6 +70,17 @@ class WriteReporter(Reporter):
                 f'Discovered "{name}" {discovery.classification} {discovery.path}'
             )
             self.writer.write(f"Fingerprint path: {discovery.path.encode()}\n")
+
+    def report_undiscovered(self, properties) -> None:
+        # Golden-surface extension (PR 9): one line per undiscovered
+        # sometimes/eventually property. For "sometimes" this is the
+        # vacuity warning (an example was sought and never found); for
+        # "eventually" it is the explicit all-clear.
+        for p in sorted(properties, key=lambda p: p.name):
+            kind = getattr(p.expectation, "value", str(p.expectation))
+            self.writer.write(
+                f'Property "{p.name}" not discovered ({kind})\n'
+            )
 
 
 class TelemetryReporter(Reporter):
@@ -102,6 +120,10 @@ class TelemetryReporter(Reporter):
     def report_discoveries(self, discoveries) -> None:
         if self.inner is not None:
             self.inner.report_discoveries(discoveries)
+
+    def report_undiscovered(self, properties) -> None:
+        if self.inner is not None:
+            self.inner.report_undiscovered(properties)
 
     def delay(self) -> float:
         return self.inner.delay() if self.inner is not None else 1.0

@@ -22,6 +22,7 @@ simulation, the TPU wave/drain loops, and the sharded mesh checker).
 """
 
 from .attribution import WaveAttribution
+from .coverage import CoverageLedger, DeviceCoverage
 from .instruments import BlockInstruments, WaveInstruments
 from .metrics import (
     Counter,
@@ -54,6 +55,7 @@ _SERVER_SYMBOLS = frozenset({
     "ProgressEstimator",
     "StallWatchdog",
     "prometheus_text",
+    "registry_hygiene_problems",
 })
 
 
@@ -70,6 +72,8 @@ def __getattr__(name):
 __all__ = [
     "BlockInstruments",
     "Counter",
+    "CoverageLedger",
+    "DeviceCoverage",
     "FlightRecorder",
     "Gauge",
     "Histogram",
@@ -90,6 +94,7 @@ __all__ = [
     "instant",
     "metrics_registry",
     "prometheus_text",
+    "registry_hygiene_problems",
     "span",
     "write_chrome_trace",
 ]

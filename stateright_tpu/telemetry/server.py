@@ -121,6 +121,36 @@ def _fmt_value(v) -> str:
     return str(v)
 
 
+def registry_hygiene_problems(registry: MetricsRegistry = None,
+                              prefix: str = "stateright") -> List[str]:
+    """The metric-registry lint (run as a tier-1 test): every registered
+    name must survive the Prometheus sanitizer without colliding with a
+    different registered name — two dotted names mapping to one
+    exposition family would silently merge unrelated series. Counters
+    are checked at their exported ``_total`` spelling, so a counter
+    ``x.y`` and a gauge ``x.y_total`` collide too. Returns
+    human-readable problem strings (empty == clean)."""
+    reg = registry if registry is not None else metrics_registry()
+    seen: Dict[str, str] = {}
+    problems: List[str] = []
+    for name, inst in reg.instruments():
+        exported = sanitize_metric_name(name, prefix)
+        if isinstance(inst, Counter):
+            exported += "_total"
+        if not re.fullmatch(r"[a-zA-Z_:][a-zA-Z0-9_:]*", exported):
+            problems.append(
+                f"{name!r} sanitizes to non-Prometheus name {exported!r}"
+            )
+            continue
+        other = seen.get(exported)
+        if other is not None and other != name:
+            problems.append(
+                f"{name!r} and {other!r} both export as {exported!r}"
+            )
+        seen[exported] = name
+    return problems
+
+
 # -- progress / ETA estimation ---------------------------------------------
 
 
@@ -654,6 +684,26 @@ class MonitorCore:
             "monitor.pipeline.host_share"
         )
         self._g_pipe_gap = self.registry.gauge("monitor.pipeline.gap_share")
+        # Coverage cartography (telemetry/coverage.py): the cumulative
+        # `.coverage` spans refresh these + stream over SSE so the
+        # Explorer's coverage panel and scrapers see action coverage and
+        # vacuity risk live.
+        self._c_coverage = self.registry.counter("monitor.coverage.events")
+        self._g_cov_actions = self.registry.gauge(
+            "monitor.coverage.action_coverage"
+        )
+        self._g_cov_dead = self.registry.gauge(
+            "monitor.coverage.dead_actions"
+        )
+        self._g_cov_term = self.registry.gauge(
+            "monitor.coverage.terminal_states"
+        )
+        self._g_cov_revisit = self.registry.gauge(
+            "monitor.coverage.revisit_rate"
+        )
+        self._g_cov_sometimes = self.registry.gauge(
+            "monitor.coverage.sometimes_witnessed"
+        )
         self._pipe_wall_ms = 0.0
         self._pipe_device_ms = 0.0
         self._pipe_host_ms = 0.0
@@ -720,6 +770,8 @@ class MonitorCore:
                           waves=1)
         elif name.endswith(".pipeline") and "wall_ms" in args:
             self._on_pipeline(name, args)
+        elif name.endswith(".coverage") and "actions_fired" in args:
+            self._on_coverage(name, args)
         elif ".storage." in name:
             self.broker.publish("storage", {
                 "name": name,
@@ -798,6 +850,38 @@ class MonitorCore:
                 if self._pipe_wall_ms
                 else None
             ),
+        })
+
+    def _on_coverage(self, name, args) -> None:
+        """One cumulative coverage span (telemetry/coverage.py): refresh
+        the monitor.coverage.* gauges and stream the payload over SSE —
+        the Explorer panel re-pulls the per-action counters from /status
+        on this signal."""
+        self._c_coverage.inc()
+        fired = args.get("actions_fired")
+        total = args.get("actions_total")
+        if fired is not None and total:
+            self._g_cov_actions.set(fired / total)
+        if args.get("dead_actions") is not None:
+            self._g_cov_dead.set(args["dead_actions"])
+        if args.get("terminals") is not None:
+            self._g_cov_term.set(args["terminals"])
+        if args.get("revisit_rate") is not None:
+            self._g_cov_revisit.set(args["revisit_rate"])
+        if args.get("sometimes_witnessed") is not None:
+            self._g_cov_sometimes.set(args["sometimes_witnessed"])
+        self.broker.publish("coverage", {
+            "name": name,
+            **{
+                k: args.get(k)
+                for k in (
+                    "evaluated", "terminals", "actions_fired",
+                    "actions_total", "dead_actions", "revisit_rate",
+                    "sometimes_witnessed", "sometimes_total",
+                    "props_total", "orbit_compression",
+                )
+                if k in args
+            },
         })
 
     def attach(self, checker) -> "MonitorCore":

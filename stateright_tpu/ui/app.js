@@ -245,6 +245,13 @@ async function refreshMonitorStatus() {
     const util = m["monitor.pipeline.utilization"];
     if (util !== null && util !== undefined)
       $("mon-util").textContent = (100 * util).toFixed(1) + "%";
+    // Coverage cartography (coverage-mode device runs; host engines
+    // always-on): the action-coverage fraction plus the per-action bar
+    // view built from the <prefix>.coverage.action_* counters.
+    const acov = m["monitor.coverage.action_coverage"];
+    if (acov !== null && acov !== undefined)
+      $("mon-action-cov").textContent = (100 * acov).toFixed(0) + "%";
+    renderCoverageBars(m);
     const p = s.progress || {};
     if (p.max_depth !== null && p.max_depth !== undefined)
       $("mon-depth").textContent = p.max_depth;
@@ -254,6 +261,48 @@ async function refreshMonitorStatus() {
   } catch (err) {
     // monitor endpoints absent or mid-teardown; leave the panel as-is
   }
+}
+
+// ---- coverage panel -------------------------------------------------------
+// Per-action fired/fresh bars from the registry snapshot in /status:
+// `<prefix>.coverage.action_fired.<label>` counters (the live backend's
+// prefix preferred, like every other pick). Dead actions (fired == 0)
+// render flagged — the vacuity signal the panel exists for.
+
+function renderCoverageBars(m) {
+  const fired = {};
+  const fresh = {};
+  for (const k of Object.keys(m)) {
+    const fi = k.indexOf(".coverage.action_fired.");
+    const fr = k.indexOf(".coverage.action_fresh.");
+    const backendOk = !monitor.backend || k.startsWith(monitor.backend + ".");
+    if (fi >= 0 && backendOk)
+      fired[k.slice(fi + ".coverage.action_fired.".length)] = m[k];
+    if (fr >= 0 && backendOk)
+      fresh[k.slice(fr + ".coverage.action_fresh.".length)] = m[k];
+  }
+  const labels = Object.keys(fired).sort();
+  if (!labels.length) return;
+  $("coverage-panel").classList.remove("hidden");
+  const peak = Math.max(...labels.map((l) => fired[l]), 1);
+  $("coverage-bars").innerHTML = labels
+    .map((l) => {
+      const f = fired[l] || 0;
+      const n = fresh[l] || 0;
+      const w = Math.max(1, Math.round((100 * f) / peak));
+      // Percent of the PARENT fired span (CSS resolves nested % widths
+      // against the parent), so the fresh fill is n/f of the fired bar.
+      const wn = f ? Math.round((100 * n) / f) : 0;
+      const dead = f === 0;
+      return (
+        `<div class="covrow${dead ? " dead" : ""}" title="fired=${f} fresh=${n}">` +
+        `<span class="covlabel">${esc(l)}</span>` +
+        `<span class="covbar"><span class="fired" style="width:${w}%">` +
+        `<span class="fresh" style="width:${wn}%"></span></span></span>` +
+        `<span class="covnum">${dead ? "DEAD" : fmtNum(f)}</span></div>`
+      );
+    })
+    .join("");
 }
 
 function startMonitor() {
@@ -280,6 +329,7 @@ function startMonitor() {
     if (d.utilization !== null && d.utilization !== undefined)
       $("mon-util").textContent = (100 * d.utilization).toFixed(1) + "%";
   });
+  es.addEventListener("coverage", () => refreshMonitorStatus());
   es.onerror = () => {
     // Never connected => no monitor endpoints on this server: close for
     // good, panel stays hidden. Once live, errors are transient drops —
