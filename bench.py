@@ -751,6 +751,26 @@ def _dedup_override_args():
     return ("--dedup", value) if value is not None else ()
 
 
+def _child_json(argv, timeout_s: float, label: str):
+    """Runs one bench child; returns the JSON dict from its last stdout
+    line, or None (wedge/crash/garbage). The shared leg-child protocol:
+    stderr inherits the parent's stream so diagnostics (and OOM reports)
+    surface live instead of dying with the child."""
+    try:
+        r = subprocess.run(argv, timeout=timeout_s, stdout=subprocess.PIPE)
+    except subprocess.TimeoutExpired:
+        log(f"[{label}] wedged after {timeout_s}s")
+        return None
+    lines = r.stdout.decode().strip().splitlines()
+    if r.returncode == 0 and lines:
+        try:
+            return json.loads(lines[-1])
+        except json.JSONDecodeError:
+            pass
+    log(f"[{label}] failed (rc={r.returncode})")
+    return None
+
+
 def _leg_subprocess(leg: str, pin_cpu: bool, extra=(), trace_name=None):
     """Runs one leg in a child; returns its result dict or None.
     ``trace_name`` overrides the trace filename component (the 2pc retry
@@ -767,21 +787,7 @@ def _leg_subprocess(leg: str, pin_cpu: bool, extra=(), trace_name=None):
     timeout_s = LEG_TIMEOUT_S[leg] * (3 if pin_cpu else 1)
     if pin_cpu:
         argv.append("--cpu")
-    try:
-        # stderr inherits the parent's stream: diagnostics (and OOM
-        # reports) surface live instead of dying with the child.
-        r = subprocess.run(argv, timeout=timeout_s, stdout=subprocess.PIPE)
-    except subprocess.TimeoutExpired:
-        log(f"[{leg}] wedged after {timeout_s}s")
-        return None
-    lines = r.stdout.decode().strip().splitlines()
-    if r.returncode == 0 and lines:
-        try:
-            return json.loads(lines[-1])
-        except json.JSONDecodeError:
-            pass
-    log(f"[{leg}] failed (rc={r.returncode})")
-    return None
+    return _child_json(argv, timeout_s, leg)
 
 
 def _sentinel_device_results():
@@ -829,8 +835,225 @@ def _validate_flag_combos():
             raise SystemExit(f"{flag} requires {needs}")
 
 
+SERVICE_LEG_TIMEOUT_S = 1500
+
+
+def _pct(values, p):
+    """Linear-interpolated percentile (None-safe: None values dropped;
+    empty -> None). Stdlib-only so the record never depends on numpy."""
+    vals = sorted(v for v in values if v is not None)
+    if not vals:
+        return None
+    if len(vals) == 1:
+        return vals[0]
+    pos = (p / 100.0) * (len(vals) - 1)
+    lo = int(pos)
+    hi = min(lo + 1, len(vals) - 1)
+    return vals[lo] + (vals[hi] - vals[lo]) * (pos - lo)
+
+
+def _run_service_leg(pin_cpu: bool):
+    """Child entry: the checking-as-a-service latency leg (BENCH_r10+).
+
+    Three phases on the 2pc-N workload (its ``sometimes`` agreement
+    properties make time-to-first-violation/witness a real latency
+    signal while the ``always`` property keeps the run exhaustive):
+
+    1. a batch ``spawn_tpu_bfs`` reference run (the throughput yardstick),
+    2. one job through ``CheckService`` (service overhead must stay
+       within 10% of the batch path),
+    3. >= 4 concurrent jobs under a sub-second quantum: per-job
+       submit->first-discovery latency (p50/p99), aggregate states/s,
+       preemption counts, and the shared-AOT-cache evidence (jobs with
+       zero compile phases in their attribution ledgers).
+    """
+    import jax
+
+    if pin_cpu:
+        jax.config.update("jax_platforms", "cpu")
+    from stateright_tpu.utils.compile_cache import enable_persistent_cache
+
+    enable_persistent_cache()
+    from stateright_tpu.models.two_phase_commit import TwoPhaseSys
+    from stateright_tpu.service import CheckService
+
+    device = jax.devices()[0]
+    log(f"[service] device: {device.platform} ({device})")
+    jobs_n = int(_parse_float_flag("--service-jobs") or 4)
+    quantum = _parse_float_flag("--service-quantum") or 0.5
+    rm = int(_parse_float_flag("--service-rm") or 5)
+    spawn = dict(frontier_capacity=1 << 10, table_capacity=1 << 15)
+    out = {
+        "device": device.platform,
+        "model": f"2pc-{rm}",
+        "jobs": jobs_n,
+        "quantum_s": quantum,
+    }
+
+    # 1. Batch reference (the normal spawn path, identical capacities).
+    t0 = time.time()
+    batch = TwoPhaseSys(rm).checker().spawn_tpu_bfs(**spawn).join()
+    wall = time.time() - t0
+    warm = batch.warmup_seconds or 0.0
+    expected = batch.unique_state_count()
+    out["expected_unique"] = expected
+    out["batch_rate"] = expected / max(wall - warm, 1e-9)
+    log(f"[service] batch: {expected} unique, {out['batch_rate']:,.0f}/s")
+
+    svc = CheckService(quantum_s=quantum, default_spawn=spawn)
+    try:
+        # 2. Single job: no contention, so no preemption — the measured
+        # delta vs batch is pure service overhead (scheduler polling).
+        h = svc.submit(model_name="2pc", model_args={"rm_count": rm})
+        res = h.result(timeout=SERVICE_LEG_TIMEOUT_S / 2)
+        if res["unique"] != expected:
+            raise AssertionError(
+                f"service single-job count mismatch: "
+                f"{res['unique']} != {expected}"
+            )
+        out["single_job_rate"] = res["rate"]
+        out["service_overhead_pct"] = 100.0 * (
+            1.0 - res["rate"] / out["batch_rate"]
+        )
+        log(
+            f"[service] single job: {res['rate']:,.0f}/s "
+            f"({out['service_overhead_pct']:+.1f}% vs batch)"
+        )
+
+        # 3. Concurrent load: attribution per job so the ledger proves
+        # the AOT-cache sharing (compile-free jobs) and shows preempt
+        # overhead as checkpoint phases.
+        t0 = time.time()
+        handles = [
+            svc.submit(
+                model_name="2pc",
+                model_args={"rm_count": rm},
+                spawn={"attribution": True},
+                tenant=f"tenant-{i}",
+            )
+            for i in range(jobs_n)
+        ]
+        for h in handles:
+            h.result(timeout=SERVICE_LEG_TIMEOUT_S)
+        wall = time.time() - t0
+        per_job, ttfvs, zero_compile, total_unique = [], [], 0, 0
+        for h in handles:
+            st = h.status()
+            r = st["result"]
+            if r["unique"] != expected:
+                raise AssertionError(
+                    f"{st['job_id']} count mismatch: "
+                    f"{r['unique']} != {expected}"
+                )
+            total_unique += r["unique"]
+            lat = st["latency"]
+            ttfvs.append(lat["ttfv_s"])
+            attr = r.get("attribution") or {}
+            # compile_s_total spans every incarnation of a preempted job
+            # (the per-run registry accumulates across resumes); the
+            # final-ledger sum is the fallback for old records.
+            compile_s = r.get("compile_s_total")
+            if compile_s is None:
+                compile_s = attr.get("phases_s", {}).get("compile", 0.0)
+                compile_s += (attr.get("outside_wave_s") or {}).get(
+                    "compile", 0.0
+                )
+            if compile_s == 0.0:
+                zero_compile += 1
+            per_job.append(
+                {
+                    "job_id": st["job_id"],
+                    "tenant": st["tenant"],
+                    "unique": r["unique"],
+                    "ttfv_s": lat["ttfv_s"],
+                    "wall_s": lat["wall_s"],
+                    "active_s": lat["active_s"],
+                    "queued_s": lat["queued_s"],
+                    "preempts": st["preempts"],
+                    "slices": st["slices"],
+                    "rate": r["rate"],
+                    "compile_s": compile_s,
+                }
+            )
+        out["aggregate_states_per_s"] = total_unique / wall
+        out["service_rate"] = out["aggregate_states_per_s"]
+        out["concurrent_wall_s"] = wall
+        out["p50_ttfv_s"] = _pct(ttfvs, 50)
+        out["p99_ttfv_s"] = _pct(ttfvs, 99)
+        out["preempts_total"] = sum(j["preempts"] for j in per_job)
+        out["jobs_zero_compile"] = zero_compile
+        out["per_job"] = per_job
+        def fmt_s(v):
+            # ttfv percentiles are None when no job ever discovered a
+            # property — the log line must not crash a leg whose
+            # throughput/preemption data is complete.
+            return "n/a" if v is None else f"{v:.2f}s"
+
+        log(
+            f"[service] {jobs_n} concurrent: "
+            f"{out['aggregate_states_per_s']:,.0f}/s aggregate, "
+            f"ttfv p50={fmt_s(out['p50_ttfv_s'])} "
+            f"p99={fmt_s(out['p99_ttfv_s'])}, "
+            f"{out['preempts_total']} preempts, "
+            f"{zero_compile}/{jobs_n} jobs compile-free"
+        )
+    finally:
+        svc.close()
+    print(json.dumps(out))
+
+
+def _main_service():
+    """Parent entry for ``bench.py --service``: runs the service leg in
+    a child (wedge isolation, like every other leg) and prints the one
+    BENCH-record JSON line."""
+    on_accel = _accelerator_usable()
+    passthrough = []
+    for flag in ("--service-jobs", "--service-quantum", "--service-rm"):
+        value = _parse_float_flag(flag)
+        if value is not None:
+            passthrough += [flag, str(value)]
+
+    def run(pin_cpu):
+        argv = [sys.executable, __file__, "--service-leg", *passthrough]
+        if pin_cpu:
+            argv.append("--cpu")
+        return _child_json(
+            argv, SERVICE_LEG_TIMEOUT_S * (3 if pin_cpu else 1), "service"
+        )
+
+    rec = run(pin_cpu=not on_accel)
+    if rec is None and on_accel:
+        log("[service] falling back to CPU-pinned run")
+        rec = run(pin_cpu=True)
+    if rec is None:
+        print(
+            json.dumps(
+                {
+                    "metric": "service aggregate unique states/sec "
+                    "(CheckService, concurrent 2pc)",
+                    "value": 0,
+                    "unit": "unique states/sec",
+                    "error": "service leg failed on every backend",
+                }
+            )
+        )
+        return
+    line = {
+        "metric": "service aggregate unique states/sec "
+        f"(CheckService, {rec['jobs']} concurrent {rec['model']})",
+        "value": round(rec["aggregate_states_per_s"], 1),
+        "unit": "unique states/sec",
+        **rec,
+    }
+    print(json.dumps(line))
+
+
 def main():
     _validate_flag_combos()
+    if "--service-leg" in sys.argv:
+        return _run_service_leg("--cpu" in sys.argv)
+    if "--service" in sys.argv:
+        return _main_service()
     if "--breakdown" in sys.argv:
         return _run_breakdown(
             sys.argv[sys.argv.index("--breakdown") + 1], "--cpu" in sys.argv

@@ -48,16 +48,32 @@ _LEG_RATE_RE = re.compile(
 )
 _VALUE_RE = re.compile(r'"value"\s*:\s*([0-9.eE+-]+)(?=[,}\s])')
 _ADVISORY_RE = re.compile(r'"([A-Za-z0-9_]+)_advisory"\s*:\s*true')
+_METRIC_LEG_RE = re.compile(r'"metric"\s*:\s*"([A-Za-z0-9_]+)')
 
 PRIMARY_LEG = "2pc"
+
+
+def _primary_leg_of(metric) -> str:
+    """The leg the headline "value" belongs to: the metric string's
+    leading word ("2pc-7 exhaustive ..." -> "2pc", "service aggregate
+    ..." -> "service"). Attributing a service-bench aggregate to the
+    2pc leg would poison the trajectory gate with an apples-to-oranges
+    regression."""
+    if not metric:
+        return PRIMARY_LEG
+    head = re.match(r"[A-Za-z0-9_]+", str(metric))
+    return head.group(0) if head else PRIMARY_LEG
 
 
 def _rates_from_text(text):
     rates, advisory = {}, set()
     m = _VALUE_RE.search(text)
     if m:
+        metric = _METRIC_LEG_RE.search(text)
         try:
-            rates[PRIMARY_LEG] = float(m.group(1))
+            rates[
+                _primary_leg_of(metric.group(1) if metric else None)
+            ] = float(m.group(1))
         except ValueError:
             pass  # interleaved-write garbage ('1.23.4'): DROP, don't die
     for leg, value in _LEG_RATE_RE.findall(text):
@@ -76,7 +92,9 @@ def _rates_from_line(line: dict):
     rates, advisory = {}, set()
     if "value" in line:
         try:
-            rates[PRIMARY_LEG] = float(line["value"])
+            rates[_primary_leg_of(line.get("metric"))] = float(
+                line["value"]
+            )
         except (TypeError, ValueError):
             pass  # null/garbage from a torn or hand-edited file: DROP
     for key, value in line.items():

@@ -1,0 +1,153 @@
+#!/usr/bin/env python
+"""Per-job latency/throughput/preemption report from a service bench
+record.
+
+    python scripts/service_report.py BENCH_r10.json [--json]
+
+Reads the JSON line ``bench.py --service`` prints (saved as
+``BENCH_r*.json``, or the raw ``--service-leg`` record) and renders the
+checking-as-a-service numbers: batch-vs-service throughput, the
+concurrent-load aggregate, the p50/p99 time-to-first-violation
+latencies, and the per-job table (ttfv, wall, queued, preempts, slices,
+compile seconds — zero compile == the job rode the shared AOT cache).
+
+``--json`` emits the summary as one JSON object instead of the tables
+(machine-readable; the tests consume it) — the convention shared by
+``gap_report.py`` / ``storage_report.py`` / ``coverage_report.py``.
+Stdlib-only, like every report reader here: bench records outlive the
+runs that wrote them.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def load_record(path):
+    """The service record from a bench JSON file: the last parseable
+    JSON line containing ``per_job`` (files may carry stderr noise or a
+    wrapper line ahead of the record)."""
+    record = None
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                obj = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(obj, dict) and "per_job" in obj:
+                record = obj
+            elif isinstance(obj, dict) and "per_job" in (
+                obj.get("service") or {}
+            ):
+                record = obj["service"]
+    return record
+
+
+def summarize(rec):
+    per_job = rec.get("per_job") or []
+    return {
+        "model": rec.get("model"),
+        "device": rec.get("device"),
+        "jobs": rec.get("jobs", len(per_job)),
+        "quantum_s": rec.get("quantum_s"),
+        "batch_rate": rec.get("batch_rate"),
+        "single_job_rate": rec.get("single_job_rate"),
+        "service_overhead_pct": rec.get("service_overhead_pct"),
+        "aggregate_states_per_s": rec.get("aggregate_states_per_s"),
+        "concurrent_wall_s": rec.get("concurrent_wall_s"),
+        "p50_ttfv_s": rec.get("p50_ttfv_s"),
+        "p99_ttfv_s": rec.get("p99_ttfv_s"),
+        "preempts_total": rec.get("preempts_total"),
+        "jobs_zero_compile": rec.get("jobs_zero_compile"),
+        "per_job": per_job,
+    }
+
+
+def _fmt(v, spec="{:,.1f}", none="-"):
+    if v is None:
+        return none
+    return spec.format(v)
+
+
+def render(summary, out=sys.stdout):
+    w = out.write
+    w(
+        f"service bench: {summary['jobs']} concurrent "
+        f"{summary['model']} jobs on {summary['device']} "
+        f"(quantum {summary['quantum_s']}s)\n\n"
+    )
+    w("  throughput (unique states/s)\n")
+    w(f"    batch path        {_fmt(summary['batch_rate'])}\n")
+    w(
+        f"    service, 1 job    {_fmt(summary['single_job_rate'])}"
+        f"  ({_fmt(summary['service_overhead_pct'], '{:+.1f}')}% overhead)\n"
+    )
+    w(
+        f"    service, {summary['jobs']} jobs   "
+        f"{_fmt(summary['aggregate_states_per_s'])}  aggregate over "
+        f"{_fmt(summary['concurrent_wall_s'], '{:.1f}')}s\n\n"
+    )
+    w("  latency (submit -> first violation/witness)\n")
+    w(f"    p50  {_fmt(summary['p50_ttfv_s'], '{:.3f}')}s\n")
+    w(f"    p99  {_fmt(summary['p99_ttfv_s'], '{:.3f}')}s\n\n")
+    w(
+        f"  scheduling: {summary['preempts_total']} preemptions; "
+        f"{summary['jobs_zero_compile']}/{summary['jobs']} jobs "
+        "compile-free (shared AOT cache)\n\n"
+    )
+    header = (
+        f"  {'job':<10} {'tenant':<10} {'ttfv_s':>8} {'wall_s':>8} "
+        f"{'queued_s':>9} {'rate':>10} {'preempts':>8} {'slices':>6} "
+        f"{'compile_s':>9}\n"
+    )
+    w(header)
+    w("  " + "-" * (len(header) - 3) + "\n")
+    for j in summary["per_job"]:
+        w(
+            f"  {j.get('job_id', '?'):<10} {str(j.get('tenant', '')):<10} "
+            f"{_fmt(j.get('ttfv_s'), '{:.3f}'):>8} "
+            f"{_fmt(j.get('wall_s'), '{:.2f}'):>8} "
+            f"{_fmt(j.get('queued_s'), '{:.3f}'):>9} "
+            f"{_fmt(j.get('rate')):>10} "
+            f"{j.get('preempts', 0):>8} {j.get('slices', 0):>6} "
+            f"{_fmt(j.get('compile_s'), '{:.2f}'):>9}\n"
+        )
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="Render a bench.py --service record "
+        "(latency/throughput/preemption per job)."
+    )
+    parser.add_argument("record", help="BENCH_r*.json / service leg JSON")
+    parser.add_argument(
+        "--json", action="store_true",
+        help="emit the summary as one JSON object (machine-readable)",
+    )
+    args = parser.parse_args(argv)
+    rec = load_record(args.record)
+    if rec is None:
+        print(
+            f"{args.record}: no service record found (need a JSON line "
+            "with 'per_job' — run `python bench.py --service`)",
+            file=sys.stderr,
+        )
+        return 2
+    summary = summarize(rec)
+    if args.json:
+        # One JSON object on stdout — the shared machine-readable
+        # convention (gap_report.py --json, storage_report.py --json).
+        json.dump(summary, sys.stdout, indent=2, sort_keys=True)
+        sys.stdout.write("\n")
+    else:
+        render(summary)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

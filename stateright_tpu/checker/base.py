@@ -81,12 +81,45 @@ class Checker(Generic[State, Action]):
         """The first exception raised by a worker thread, if any."""
         return None
 
+    # -- preemption (device checkers implement; see checker/tpu.py) --------
+
+    _preempt_payload = None
+
+    def request_preempt(self) -> None:
+        """Asks the worker to suspend at the next wave boundary and
+        drain its state into an in-memory checkpoint payload. Device
+        checkers implement this (the service's scheduler uses it); the
+        host engines' per-state loops have no payload format to yield."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support preemption"
+        )
+
+    @property
+    def preempted(self) -> bool:
+        """True when the worker suspended in response to a preempt
+        request (the run is incomplete and resumable)."""
+        return self._preempt_payload is not None
+
+    def preempt_payload(self):
+        """The suspended run's in-memory checkpoint payload, or None
+        (not preempted / finished first). Pass as ``resume_from=``."""
+        return self._preempt_payload
+
+    # Run identity: checkers spawned with ``run_id=`` record into their
+    # own metrics registry and stamp their trace spans, so concurrent
+    # runs in one process never collide (the service's per-job scoping).
+    run_id = None
+    _registry = None
+
     def metrics(self):
-        """The telemetry metrics registry this checker records into (the
-        process-local default: every backend emits per-wave/per-block
-        counters, gauges, and histograms there — see
-        ``stateright_tpu.telemetry``). ``metrics().snapshot()`` is the
-        cheap point-in-time view reporters and benches consume."""
+        """The telemetry metrics registry this checker records into:
+        the process-local default, or — when the checker was spawned
+        with ``run_id=`` — that run's own registry (see
+        ``stateright_tpu.telemetry.metrics_registry``).
+        ``metrics().snapshot()`` is the cheap point-in-time view
+        reporters and benches consume."""
+        if self._registry is not None:
+            return self._registry
         from ..telemetry import metrics_registry
 
         return metrics_registry()
@@ -106,7 +139,9 @@ class Checker(Generic[State, Action]):
         self._attr = (
             attribution
             if isinstance(attribution, WaveAttribution)
-            else WaveAttribution(prefix, tracer=self._tracer)
+            else WaveAttribution(
+                prefix, tracer=self._tracer, registry=self.metrics()
+            )
         )
 
     def _phase(self, name: str):
@@ -172,6 +207,7 @@ class Checker(Generic[State, Action]):
             action_labels=coverage_action_labels(model, action_count),
             symmetry=symmetry,
             tracer=self._tracer,
+            registry=self.metrics(),
         )
         self._cov_layout = DeviceCoverage(
             action_count, len(props), symmetry=symmetry
@@ -217,9 +253,16 @@ class Checker(Generic[State, Action]):
         (SSE wave/storage stream). ``port=0`` binds an ephemeral port
         (``monitor.port`` / ``monitor.url``); pass ``stall_deadline_s=``
         to arm the watchdog and ``flight_recorder=True`` for crash
-        dumps. Returns the server; call ``monitor.close()`` when done."""
+        dumps. A checker spawned with ``run_id=`` serves ITS registry
+        and only its own wave stream (``run_filter``), so a multi-job
+        process can serve one monitor per job. Returns the server; call
+        ``monitor.close()`` when done."""
         from ..telemetry.server import MonitorServer
 
+        kwargs.setdefault("registry", self.metrics())
+        if self.run_id is not None:
+            kwargs.setdefault("run_id", self.run_id)
+            kwargs.setdefault("run_filter", self.run_id)
         return MonitorServer(checker=self, port=port, **kwargs)
 
     def state_digest(self) -> dict:

@@ -197,6 +197,27 @@ _CONTENT_TYPES = {
 }
 
 
+def ui_asset(path: str):
+    """Resolves a request path against the bundled UI directory:
+    ``(content_type, bytes)`` or None (missing file, or a traversal
+    attempt outside the UI dir). Shared by the Explorer and the service
+    front-end so the traversal guard lives in exactly one place."""
+    name = "index.html" if path in ("/", "") else path.lstrip("/")
+    file = (_UI_DIR / name).resolve()
+    try:
+        inside = file.is_relative_to(_UI_DIR)
+    except AttributeError:  # Python < 3.9
+        import os
+
+        inside = str(file).startswith(str(_UI_DIR) + os.sep)
+    if not inside or not file.is_file():
+        return None
+    return (
+        _CONTENT_TYPES.get(file.suffix, "text/plain"),
+        file.read_bytes(),
+    )
+
+
 class _Handler(BaseHTTPRequestHandler):
     checker = None
     snapshot = None
@@ -251,22 +272,13 @@ class _Handler(BaseHTTPRequestHandler):
             self._json({"error": "not found"}, 404)
 
     def _static(self, path: str):
-        name = "index.html" if path in ("/", "") else path.lstrip("/")
-        file = (_UI_DIR / name).resolve()
-        try:
-            inside = file.is_relative_to(_UI_DIR)
-        except AttributeError:  # Python < 3.9
-            import os
-
-            inside = str(file).startswith(str(_UI_DIR) + os.sep)
-        if not inside or not file.is_file():
+        asset = ui_asset(path)
+        if asset is None:
             self._json({"error": "not found"}, 404)
             return
-        body = file.read_bytes()
+        content_type, body = asset
         self.send_response(200)
-        self.send_header(
-            "Content-Type", _CONTENT_TYPES.get(file.suffix, "text/plain")
-        )
+        self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)

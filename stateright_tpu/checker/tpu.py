@@ -62,7 +62,12 @@ from ..ops.hashset import (
     hashset_new,
 )
 from ..ops.ring import ring_export, ring_push, ring_rows, ring_take
-from ..telemetry import WaveInstruments, device_step_annotation, get_tracer
+from ..telemetry import (
+    WaveInstruments,
+    device_step_annotation,
+    get_tracer,
+    metrics_registry,
+)
 from .base import _NULL_CTX, Checker  # noqa: F401 - _NULL_CTX re-exported
 
 _DEPTH_INF = (1 << 31) - 1
@@ -430,6 +435,36 @@ def _pow2ceil(n: int) -> int:
     return 1 << max(0, (n - 1).bit_length())
 
 
+# -- cross-checker AOT executable sharing (checking-as-a-service) -----------
+#
+# One resident process serving many jobs must never recompile a wave shape
+# a previous job already built: the wave/drain executables are pure XLA
+# programs (model constants baked in at trace time), so two checker
+# INSTANCES whose traces are provably identical can share them. "Provably"
+# is the caller's namespace (e.g. the service's model-zoo entry name)
+# ANDed with a full trace signature — model digest, property list, key
+# scheme, pipeline, ladder, capacities — so a namespace collision between
+# genuinely different configurations still misses instead of corrupting.
+_AOT_LOCK = threading.Lock()
+_AOT_CACHES: Dict[tuple, dict] = {}
+
+
+def shared_aot_cache(namespace: str, signature: tuple) -> dict:
+    """The process-global executable dict for one (namespace, signature)
+    — get-or-create, so every checker spawned with the same
+    ``aot_cache=namespace`` and an identical trace signature probes and
+    populates the same cache."""
+    key = (namespace, signature)
+    with _AOT_LOCK:
+        return _AOT_CACHES.setdefault(key, {})
+
+
+def clear_shared_aot_caches() -> None:
+    """Drops every shared executable (tests / memory reclamation)."""
+    with _AOT_LOCK:
+        _AOT_CACHES.clear()
+
+
 class TpuBfsChecker(Checker):
     """Requires the model to implement ``BatchableModel``.
 
@@ -471,6 +506,8 @@ class TpuBfsChecker(Checker):
         spill_dir=None,
         attribution=False,
         coverage=False,
+        run_id=None,
+        aot_cache=None,
     ):
         model = options.model
         if not isinstance(model, BatchableModel):
@@ -480,6 +517,13 @@ class TpuBfsChecker(Checker):
             )
         self._model = model
         self._properties = model.properties()
+        # Run identity (checking-as-a-service): ``run_id=`` gives this
+        # checker its own metrics registry (no instrument collisions
+        # between concurrent runs in one process) and stamps every trace
+        # span with the id so monitors can select this run's stream.
+        self.run_id = run_id
+        self._registry = metrics_registry(run_id) if run_id else None
+        self._tracer = get_tracer(run_id)
         self._conditions = model.packed_conditions()
         if len(self._conditions) != len(self._properties):
             raise ValueError(
@@ -554,10 +598,15 @@ class TpuBfsChecker(Checker):
                 )
             self._max_capacity = max_cap
             self._capacity = min(self._capacity, max_cap)
+            from ..storage import StorageInstruments
+
             self._tier = TieredVisitedStore(
                 host_budget_mib=host_budget_mib,
                 spill_dir=spill_dir,
-                prefix="tpu_bfs",
+                instruments=StorageInstruments(
+                    "tpu_bfs", registry=self._registry
+                ),
+                tracer=self._tracer,
             )
         # Keys currently RESIDENT in the device table (== unique_count
         # until the first eviction; afterwards the table holds only the
@@ -660,8 +709,8 @@ class TpuBfsChecker(Checker):
         # one span per wave (frontier width, new-unique, dedup hit-rate,
         # hash-set occupancy, max depth) through them — the live
         # observability the offline breakdown.py stage mirror cannot give.
-        self._tracer = get_tracer()
-        self._wi = WaveInstruments("tpu_bfs")
+        # (Tracer/registry already bound above — run_id-scoped when set.)
+        self._wi = WaveInstruments("tpu_bfs", registry=self._registry)
         # Wave-timeline attribution (opt-in, telemetry/attribution.py):
         # fences each wave at phase boundaries and classifies its wall
         # into device/host_probe/evict/table_grow/checkpoint/compile/gap.
@@ -671,6 +720,12 @@ class TpuBfsChecker(Checker):
         self._ingest_lock = threading.Lock()
         self._done_event = threading.Event()
         self._error: Optional[BaseException] = None
+        # Preemption (checking-as-a-service): request_preempt() asks the
+        # worker to suspend at the next wave/drain boundary; the run's
+        # state drains into an in-memory checkpoint payload instead of a
+        # file and the worker exits (see request_preempt).
+        self._preempt_event = threading.Event()
+        self._preempt_payload: Optional[dict] = None
 
         # Fingerprints go through the model's view hook (e.g. actor systems
         # exclude crash flags, mirroring the host state hash).
@@ -739,6 +794,25 @@ class TpuBfsChecker(Checker):
         # pays for exactly one drain compile.
         self._drain_jits = {}
         self._drain_exec = {}
+        # Cross-job sharing: with ``aot_cache="<namespace>"`` the two
+        # executable dicts come from the process-global cache instead, so
+        # same-shaped waves across checker instances (the service's jobs,
+        # a preempted job's resumed incarnation) never recompile. The
+        # namespace asserts semantic equivalence the trace signature
+        # cannot see (e.g. property conditions closing over model fields
+        # outside the packed arrays); the signature guards everything it
+        # can see, so a namespace reuse across different shapes/configs
+        # misses instead of corrupting.
+        if aot_cache is not None:
+            if self._sym_scheme == CUSTOM_REP_SCHEME:
+                raise ValueError(
+                    "aot_cache cannot be shared under a custom "
+                    "symmetry_fn: the traced key function is caller "
+                    "code the cache signature cannot compare"
+                )
+            sig = self._aot_signature()
+            self._wave_exec = shared_aot_cache(aot_cache, ("wave",) + sig)
+            self._drain_exec = shared_aot_cache(aot_cache, ("drain",) + sig)
         self._jit_pool_zero = jax.jit(self._pool_zero, static_argnums=(0,))
         # The ring is rebound to the returned one; the pushed chunk's
         # buffers cannot alias the ring (scatter), so donating them would
@@ -1335,6 +1409,29 @@ class TpuBfsChecker(Checker):
         )
         return new_table, pending.sum()
 
+    def _aot_signature(self) -> tuple:
+        """Everything baked into the wave/drain traces that the shared
+        AOT cache must key on (runtime args — depth cap, budget,
+        undiscovered mask — excluded; runtime SHAPES — table rows, bucket
+        width, pool capacity — ride the per-entry keys)."""
+        return (
+            jax.default_backend(),
+            packed_model_digest(self._model, self._A),
+            tuple(
+                (p.name, str(p.expectation)) for p in self._properties
+            ),
+            self._sym_scheme,
+            self._use_fps,
+            self._wave_dedup,
+            self._hashset_impl,
+            self._cov is not None,
+            self._F_max,
+            tuple(self._buckets),
+            self._drain_log_capacity,
+            self._max_drain_waves,
+            self._max_capacity,
+        )
+
     # -- host exploration loop ---------------------------------------------
 
     def _run(self):
@@ -1751,6 +1848,16 @@ class TpuBfsChecker(Checker):
                 and self._target_state_count <= self._state_count
             ):
                 break
+            if self._preempt_event.is_set():
+                # Wave-granular yield point: the pending chunk queue IS
+                # the whole remaining frontier here, so the checkpoint
+                # payload machinery captures the run exactly (resume is
+                # bit-identical — same argument as checkpoint/restore).
+                self._preempt_payload = self.checkpoint_payload(list(queue))
+                self._tracer.instant(
+                    "tpu_bfs.preempted", chunks=len(queue), mode="wave"
+                )
+                return
             # The attribution window covers the whole iteration (the
             # inter-wave checkpoint and pre-grow included): its phases
             # plus the residual gap sum to this wall by construction.
@@ -1815,6 +1922,21 @@ class TpuBfsChecker(Checker):
         while True:
             if len(self._discoveries_fp) == len(props):
                 break
+            if self._preempt_event.is_set():
+                # Drain-granular yield point. Ring contents are OLDER
+                # than any host-queue spill (same ordering argument as
+                # _handoff_queue), so ring-then-queue preserves exact
+                # FIFO and the resumed run stays bit-identical. A drain
+                # yields only between drains; bound preemption latency
+                # with max_drain_waves (the service spawns jobs with a
+                # small cap, like the checkpoint-durability clamp).
+                chunks = self._export_pool_chunks(pool, head, count)
+                chunks.extend(queue)
+                self._preempt_payload = self.checkpoint_payload(chunks)
+                self._tracer.instant(
+                    "tpu_bfs.preempted", chunks=len(chunks), mode="drain"
+                )
+                return None
             # First L0 eviction ends deep-drain mode: from here every
             # wave's fresh set needs the host-side L1/L2 probe, which a
             # device-resident drain cannot perform mid-loop.
@@ -2145,6 +2267,13 @@ class TpuBfsChecker(Checker):
         map, and the pending frontier chunks. The visited set is not stored
         separately — it is exactly the parent map's keys, and the device
         table is rebuilt from them on resume."""
+        atomic_pickle(path, self.checkpoint_payload(queue))
+
+    def checkpoint_payload(self, queue) -> dict:
+        """The checkpoint as an in-memory payload dict (format v2, the
+        exact object ``save_checkpoint`` pickles). The preempt/resume
+        path round-trips this without touching disk: pass it straight to
+        a new checker's ``resume_from=``."""
         self._ingest_wave_log()
         children, parents = self._store.export()
         payload = {
@@ -2178,13 +2307,18 @@ class TpuBfsChecker(Checker):
             # rebuilt on restore as "known keys not in any run", which
             # always fits the budget.
             payload["storage"] = self._tier.export_state()
-        atomic_pickle(path, payload)
+        return payload
 
     def _restore(self, path):
-        import pickle
+        if isinstance(path, dict):
+            # In-memory resume (preempt/resume): the payload dict itself,
+            # no pickle round trip.
+            payload = path
+        else:
+            import pickle
 
-        with open(path, "rb") as f:
-            payload = pickle.load(f)
+            with open(path, "rb") as f:
+                payload = pickle.load(f)
         validate_checkpoint_header(
             payload,
             "tpu_bfs",
@@ -2219,7 +2353,14 @@ class TpuBfsChecker(Checker):
                 # (unbounded L0 from here on, probes stay correct).
                 from ..storage import TieredVisitedStore
 
-                self._tier = TieredVisitedStore(prefix="tpu_bfs")
+                from ..storage import StorageInstruments
+
+                self._tier = TieredVisitedStore(
+                    instruments=StorageInstruments(
+                        "tpu_bfs", registry=self._registry
+                    ),
+                    tracer=self._tracer,
+                )
             self._tier.load_state(storage_state)
         insert_keys = keys
         if self._tier is not None and not self._tier.is_empty():
@@ -2374,6 +2515,21 @@ class TpuBfsChecker(Checker):
         chain = self._store.chain(fp)
         return Path.from_fingerprints(self._model, chain, fp_of=self._host_fp)
 
+    # -- preemption (checking-as-a-service) --------------------------------
+
+    def request_preempt(self) -> None:
+        """Asks the worker to suspend at the next wave/drain boundary:
+        the run's full state (counters, parent map, pending frontier,
+        storage tiers) drains into an in-memory checkpoint payload
+        (``preempt_payload()``) and the worker thread exits. Resume by
+        spawning a new checker with ``resume_from=<payload>`` and the
+        same configuration — the resumed run is bit-identical to an
+        uninterrupted one (counts, depths, discoveries, golden reporter;
+        same machinery as checkpoint/restore, minus the pickle). A run
+        that finishes before reaching a yield point completes normally
+        and ``preempt_payload()`` stays None."""
+        self._preempt_event.set()
+
     # -- Checker surface ---------------------------------------------------
 
     @property
@@ -2429,6 +2585,7 @@ class TpuBfsChecker(Checker):
             warmup_seconds=getattr(self, "warmup_seconds", None),
             checkpoint_path=self._checkpoint_path,
             last_dispatch=self._last_dispatch,
+            preempted=self.preempted,
         )
         if self._tier is not None:
             try:

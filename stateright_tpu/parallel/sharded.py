@@ -65,7 +65,12 @@ from ..native import make_fingerprint_store
 from ..ops.fingerprint import fingerprint_state, fp64_pairs, fp_to_int
 from ..ops.hashset import MAX_PROBES, hashset_insert
 from ..ops.ring import ring_export, ring_push, ring_rows, ring_take
-from ..telemetry import WaveInstruments, device_step_annotation, get_tracer
+from ..telemetry import (
+    WaveInstruments,
+    device_step_annotation,
+    get_tracer,
+    metrics_registry,
+)
 from .base_mesh import default_mesh
 from ..checker.base import Checker
 from ..checker.tpu import (
@@ -139,6 +144,7 @@ class ShardedTpuBfsChecker(Checker):
         spill_dir=None,
         attribution=False,
         coverage=False,
+        run_id=None,
     ):
         model = options.model
         if not isinstance(model, BatchableModel):
@@ -146,6 +152,11 @@ class ShardedTpuBfsChecker(Checker):
                 f"spawn_sharded_tpu_bfs requires a BatchableModel; "
                 f"{type(model).__name__} does not implement the packed protocol"
             )
+        # Run identity (checking-as-a-service): own metrics registry +
+        # run-stamped trace spans, mirroring TpuBfsChecker.
+        self.run_id = run_id
+        self._registry = metrics_registry(run_id) if run_id else None
+        self._tracer = get_tracer(run_id)
         self._mesh = mesh if mesh is not None else default_mesh()
         n = self._mesh.devices.size
         self._n = n
@@ -255,7 +266,9 @@ class ShardedTpuBfsChecker(Checker):
                 )
             self._max_cap_loc = max_cap
             self._cap_loc = min(self._cap_loc, max_cap)
-            self._si = StorageInstruments("sharded_bfs")
+            self._si = StorageInstruments(
+                "sharded_bfs", registry=self._registry
+            )
             self._tiers = [
                 TieredVisitedStore(
                     host_budget_mib=(
@@ -266,6 +279,7 @@ class ShardedTpuBfsChecker(Checker):
                     spill_dir=spill_dir,
                     instruments=self._si,
                     shard=d,
+                    tracer=self._tracer,
                 )
                 for d in range(n)
             ]
@@ -290,6 +304,11 @@ class ShardedTpuBfsChecker(Checker):
         self._ingest_lock = threading.Lock()
         self._done_event = threading.Event()
         self._error: Optional[BaseException] = None
+        # Preemption (checking-as-a-service): wave/drain-boundary yield
+        # points drain the run into an in-memory checkpoint payload —
+        # same API as TpuBfsChecker (see checker/base.py).
+        self._preempt_event = threading.Event()
+        self._preempt_payload: Optional[dict] = None
 
         self._shard = NamedSharding(self._mesh, P("fp"))
         self._replicated = NamedSharding(self._mesh, P())
@@ -383,8 +402,8 @@ class ShardedTpuBfsChecker(Checker):
 
         # Telemetry: one span per host-visible wave/drain (see
         # stateright_tpu.telemetry); occupancy is global across shards.
-        self._tracer = get_tracer()
-        self._wi = WaveInstruments("sharded_bfs")
+        # (Tracer/registry already bound above — run_id-scoped when set.)
+        self._wi = WaveInstruments("sharded_bfs", registry=self._registry)
         # Wave-timeline attribution (opt-in, telemetry/attribution.py):
         # same engine and phase names as TpuBfsChecker, prefixed
         # ``sharded_bfs`` — results stay bit-identical (fences change
@@ -1182,6 +1201,19 @@ class ShardedTpuBfsChecker(Checker):
                 and self._target_state_count <= self._state_count
             ):
                 break
+            if self._preempt_event.is_set():
+                # Wave-granular yield: the host pool IS the whole
+                # remaining frontier here (no chunk in flight between
+                # iterations), so the checkpoint payload captures the
+                # run exactly and the resume is bit-identical.
+                self._preempt_payload = self.checkpoint_payload(
+                    list(self._pool)
+                )
+                self._tracer.instant(
+                    "sharded_bfs.preempted", batches=len(self._pool),
+                    mode="wave",
+                )
+                return
             # Attribution window over the whole iteration (checkpoint +
             # pre-grow + dispatch + harvest). No early exit lives inside
             # it, so a plain with-block is exact; an exception unwinds
@@ -1399,6 +1431,18 @@ class ShardedTpuBfsChecker(Checker):
         while True:
             if len(self._discoveries_fp) == len(props):
                 break
+            if self._preempt_event.is_set():
+                # Drain-granular yield: rings + host-pool leftovers are
+                # the whole pending frontier between drains (same
+                # capture as _checkpoint_rings), into an in-memory
+                # payload instead of a file.
+                self._preempt_payload = self.checkpoint_payload(
+                    self._rings_pool_batches(pool, head, count)
+                )
+                self._tracer.instant(
+                    "sharded_bfs.preempted", mode="drain"
+                )
+                return
             pool, head, count, ring_est = self._feed_rings(
                 pool, head, count, ring_est
             )
@@ -1651,9 +1695,10 @@ class ShardedTpuBfsChecker(Checker):
             self._cov.emit_wave_span()
         return table, pool, head, count, ring_est
 
-    def _checkpoint_rings(self, pool, head, count):
-        """Deep-mode checkpoint: exports the rings into one host row-batch
-        and saves it alongside any host-pool leftovers."""
+    def _rings_pool_batches(self, pool, head, count):
+        """The whole pending frontier in deep mode, as host row-batches:
+        any host-pool leftovers plus the rings exported into one batch
+        (the shape ``save_checkpoint``/``checkpoint_payload`` take)."""
         exported = self._jit_ring_export(pool, head, count)
         mask = self._pull(exported["mask"])
         batch = {
@@ -1665,8 +1710,13 @@ class ShardedTpuBfsChecker(Checker):
             for k, v in exported.items()
             if k != "mask"
         }
+        return list(self._pool) + [batch]
+
+    def _checkpoint_rings(self, pool, head, count):
+        """Deep-mode checkpoint: exports the rings into one host row-batch
+        and saves it alongside any host-pool leftovers."""
         self.save_checkpoint(
-            self._checkpoint_path, list(self._pool) + [batch]
+            self._checkpoint_path, self._rings_pool_batches(pool, head, count)
         )
 
     def _seed(self):
@@ -1748,6 +1798,16 @@ class ShardedTpuBfsChecker(Checker):
         queue parameter — calling this from another thread mid-run would
         race the worker's pool mutation and could snapshot an in-flight
         chunk out of existence."""
+        payload = self.checkpoint_payload(pool)
+        # Multi-controller: every process builds the identical payload;
+        # exactly one writes the file.
+        if jax.process_index() == 0:
+            atomic_pickle(path, payload)
+
+    def checkpoint_payload(self, pool) -> dict:
+        """The checkpoint as an in-memory payload dict (the exact object
+        ``save_checkpoint`` pickles); the preempt/resume path passes it
+        straight to a new checker's ``resume_from=``."""
         self._ingest_wave_log()
         children, parents = self._store.export()
         payload = {
@@ -1781,16 +1841,17 @@ class ShardedTpuBfsChecker(Checker):
             # checkpoint (CRC-validated on restore); the shard tables
             # rebuild as "known keys not in any run".
             payload["storage"] = [t.export_state() for t in self._tiers]
-        # Multi-controller: every process builds the identical payload;
-        # exactly one writes the file.
-        if jax.process_index() == 0:
-            atomic_pickle(path, payload)
+        return payload
 
     def _restore(self, path):
-        import pickle
+        if isinstance(path, dict):
+            # In-memory resume (preempt/resume): the payload dict itself.
+            payload = path
+        else:
+            import pickle
 
-        with open(path, "rb") as f:
-            payload = pickle.load(f)
+            with open(path, "rb") as f:
+                payload = pickle.load(f)
         validate_checkpoint_header(
             payload,
             "sharded",
@@ -1829,9 +1890,13 @@ class ShardedTpuBfsChecker(Checker):
                 # (unbounded shard tables from here on, probes correct).
                 from ..storage import StorageInstruments, TieredVisitedStore
 
-                self._si = StorageInstruments("sharded_bfs")
+                self._si = StorageInstruments(
+                    "sharded_bfs", registry=self._registry
+                )
                 self._tiers = [
-                    TieredVisitedStore(instruments=self._si, shard=d)
+                    TieredVisitedStore(
+                        instruments=self._si, shard=d, tracer=self._tracer
+                    )
                     for d in range(n)
                 ]
             if len(storage_state) == n:
@@ -2064,6 +2129,13 @@ class ShardedTpuBfsChecker(Checker):
         # full path reconstruction discoveries() performs.
         return list(self._discoveries_fp)
 
+    def request_preempt(self) -> None:
+        """Suspend at the next wave/drain boundary into an in-memory
+        checkpoint payload (``preempt_payload()``); resume with
+        ``resume_from=<payload>``. Same contract as
+        ``TpuBfsChecker.request_preempt``."""
+        self._preempt_event.set()
+
     def state_digest(self) -> dict:
         digest = super().state_digest()
         digest.update(
@@ -2072,6 +2144,7 @@ class ShardedTpuBfsChecker(Checker):
             frontier_per_device=self._F_loc,
             warmup_seconds=getattr(self, "warmup_seconds", None),
             checkpoint_path=self._checkpoint_path,
+            preempted=self.preempted,
         )
         if self._si is not None:
             try:

@@ -1,0 +1,71 @@
+"""Checking-as-a-service: a persistent, in-process multi-tenant check
+scheduler over the device checkers.
+
+The AOT wave cache is keyed on ``(bucket, table_capacity)`` under a
+model-config signature, so one resident process can serve many models and
+many requests without ever recompiling a wave shape it has already built
+— the "serve heavy traffic" shape from the ROADMAP north star, and the
+same single-device utilization problem GPUexplore solves inside one GPU
+(PAPERS: "On the Scalability of the GPUexplore Explicit-State Model
+Checker"). Three layers:
+
+- :class:`CheckService` — owns the device: an admission queue of
+  :class:`CheckJob` s (model + options + per-tenant ``hbm_budget_mib`` /
+  deadline / priority) and a scheduler loop that time-slices the device
+  between active jobs at wave granularity, using the checkpoint-v2
+  machinery for preempt/resume (``TpuBfsChecker.request_preempt`` drains
+  a job's wave state to a host-side payload; resuming it later is
+  bit-identical to an uninterrupted run). ``submit()`` returns a
+  :class:`JobHandle` (``result()`` / ``status()`` / ``cancel()``).
+- :class:`ServiceServer` — the HTTP front-end (``POST /jobs`` against
+  the registered model zoo, ``GET /jobs``, ``GET /jobs/<id>``,
+  per-job ``/jobs/<id>/metrics``, the aggregate live-monitor endpoints,
+  and the Explorer UI page with the job-list panel).
+- ``bench.py --service`` — the latency-oriented bench legs (p50/p99
+  time-to-first-violation and aggregate states/s under concurrent load;
+  ``scripts/service_report.py`` renders the records).
+
+Per-job telemetry rides the run-scoped plumbing: each job gets its own
+``run_id`` (own metrics registry, stamped trace spans), so ``/metrics``,
+``/status``, SSE, attribution, and coverage all work per job.
+"""
+
+from .jobs import (
+    JOB_CANCELLED,
+    JOB_DONE,
+    JOB_FAILED,
+    JOB_QUEUED,
+    JOB_RUNNING,
+    JOB_SUSPENDED,
+    CheckJob,
+    JobHandle,
+)
+from .service import CheckService
+from .zoo import default_zoo
+
+# ServiceServer drags in http.server; resolve lazily (PEP 562) like the
+# telemetry package does for MonitorServer.
+_HTTP_SYMBOLS = frozenset({"ServiceServer"})
+
+
+def __getattr__(name):
+    if name in _HTTP_SYMBOLS:
+        from . import http
+
+        return getattr(http, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+__all__ = [
+    "CheckJob",
+    "CheckService",
+    "JobHandle",
+    "JOB_CANCELLED",
+    "JOB_DONE",
+    "JOB_FAILED",
+    "JOB_QUEUED",
+    "JOB_RUNNING",
+    "JOB_SUSPENDED",
+    "ServiceServer",
+    "default_zoo",
+]

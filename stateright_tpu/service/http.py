@@ -1,0 +1,290 @@
+"""HTTP front-end for the check service.
+
+Extends the monitor/Explorer HTTP surface with the job API::
+
+    POST /jobs                   submit against the model zoo
+                                 {"model": "2pc", "model_args": {...},
+                                  "options": {...}, "spawn": {...},
+                                  "priority": 0, "deadline_s": null,
+                                  "tenant": "...", "hbm_budget_mib": null}
+    GET  /jobs                   every job's status (the UI panel feed)
+    GET  /jobs/<id>              one job: state, verdict, latency fields
+    POST /jobs/<id>/cancel       cancel (preempts a running job)
+    GET  /jobs/<id>/metrics      that job's registry, Prometheus text,
+                                 labeled {run_id="<id>"}
+    GET  /metrics                aggregate: default registry + every
+                                 run's registry under a run_id label
+    GET  /status, /events        the live-monitor endpoints (aggregate
+                                 across jobs: no run filter)
+    GET  /                       the Explorer UI page (the job-list
+                                 panel appears when /jobs answers)
+
+Stdlib-only, same bounded-SSE / never-block-a-checker rules as
+``telemetry/server.py`` (whose routing helpers this reuses).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from ..checker.explorer import ui_asset
+from ..telemetry.metrics import run_registries
+from ..telemetry.server import (
+    MonitorCore,
+    _send,
+    handle_monitor_get,
+    prometheus_text,
+    prometheus_text_all_runs,
+)
+from .service import CheckService
+
+# Spawn kwargs a REMOTE caller may set. Everything else is rejected:
+# `resume_from` would make the server pickle.load an attacker-chosen
+# path (code execution), `checkpoint_path`/`spill_dir`/`profile_dir`
+# are server-side file writes at client-chosen locations, and
+# `run_id`/`aot_cache` are service-managed identities. The in-process
+# Python API (`CheckService.submit`) stays unrestricted — its caller
+# already runs arbitrary code.
+_HTTP_SPAWN_KEYS = frozenset({
+    "frontier_capacity",
+    "table_capacity",
+    "max_drain_waves",
+    "drain_log_factor",
+    "pool_factor",
+    "hashset_impl",
+    "wave_dedup",
+    "expand_fps",
+    "bucket_ladder",
+    "attribution",
+    "coverage",
+})
+
+
+def _json_response(handler, payload, code=200) -> None:
+    _send(
+        handler,
+        json.dumps(payload, default=str).encode(),
+        "application/json",
+        code=code,
+    )
+
+
+class _ServiceHandler(BaseHTTPRequestHandler):
+    service: CheckService = None
+    core: MonitorCore = None
+
+    def log_message(self, *args):  # quiet by default
+        pass
+
+    # -- GET ----------------------------------------------------------------
+
+    def do_GET(self):
+        try:
+            if self.path == "/metrics":
+                # Aggregate exposition: every job's registry under a
+                # run_id label (the per-run namespacing fix means they
+                # no longer merge into one colliding registry).
+                _send(
+                    self, prometheus_text_all_runs().encode(),
+                    "text/plain; version=0.0.4; charset=utf-8",
+                )
+                return
+            if handle_monitor_get(self, self.core, self.path):
+                return
+            if self.path == "/jobs":
+                # Summary view: the UI polls this every ~2s; full
+                # verdicts (report text, ledgers) stay on /jobs/<id>.
+                _json_response(self, {
+                    "jobs": [j.summary() for j in self.service.jobs()],
+                })
+                return
+            if self.path.startswith("/jobs/"):
+                parts = [p for p in self.path.split("/") if p]
+                if len(parts) < 2:  # bare "/jobs/" (trailing slash)
+                    _json_response(self, {"error": "no such job"}, 404)
+                    return
+                job = self.service.job(parts[1])
+                if job is None:
+                    _json_response(self, {"error": "no such job"}, 404)
+                    return
+                if len(parts) == 2:
+                    _json_response(self, job.status())
+                elif len(parts) == 3 and parts[2] == "metrics":
+                    # Look up, never create: a GET for a job that has
+                    # not run yet must not leak an empty registry into
+                    # the process-wide run index.
+                    reg = run_registries().get(job.run_id)
+                    body = (
+                        prometheus_text(
+                            reg, labels={"run_id": job.run_id}
+                        )
+                        if reg is not None
+                        else "\n"
+                    )
+                    _send(
+                        self,
+                        body.encode(),
+                        "text/plain; version=0.0.4; charset=utf-8",
+                    )
+                else:
+                    _json_response(self, {"error": "not found"}, 404)
+                return
+            self._static(self.path)
+        except ConnectionError:
+            pass  # routine client disconnect mid-response
+
+    # -- POST ---------------------------------------------------------------
+
+    def do_POST(self):
+        try:
+            if self.path == "/jobs":
+                self._submit()
+                return
+            parts = [p for p in self.path.split("/") if p]
+            if (
+                len(parts) == 3
+                and parts[0] == "jobs"
+                and parts[2] == "cancel"
+            ):
+                job = self.service.job(parts[1])
+                if job is None:
+                    _json_response(self, {"error": "no such job"}, 404)
+                    return
+                from .jobs import JobHandle
+
+                cancelled = JobHandle(job, self.service).cancel()
+                _json_response(self, {
+                    "job_id": job.job_id, "cancelled": cancelled,
+                })
+                return
+            _json_response(self, {"error": "not found"}, 404)
+        except ConnectionError:
+            pass
+
+    def _submit(self) -> None:
+        try:
+            length = int(self.headers.get("Content-Length") or 0)
+            body = json.loads(self.rfile.read(length) or b"{}")
+        except (ValueError, json.JSONDecodeError):
+            _json_response(self, {"error": "invalid JSON body"}, 400)
+            return
+        name = body.get("model")
+        if not name:
+            _json_response(
+                self,
+                {"error": "missing 'model'",
+                 "zoo": sorted(self.service.zoo)},
+                400,
+            )
+            return
+        spawn = body.get("spawn") or {}
+        if not isinstance(spawn, dict):
+            _json_response(self, {"error": "spawn must be an object"}, 400)
+            return
+        blocked = set(spawn) - _HTTP_SPAWN_KEYS
+        if blocked:
+            _json_response(
+                self,
+                {"error": f"spawn keys not allowed over HTTP: "
+                          f"{sorted(blocked)}",
+                 "allowed": sorted(_HTTP_SPAWN_KEYS)},
+                400,
+            )
+            return
+        try:
+            # Raw values through: submit() coerces priority/deadline/
+            # budget itself and raises ValueError on garbage (a list
+            # priority must 400 here, not TypeError the handler).
+            handle = self.service.submit(
+                model_name=name,
+                model_args=body.get("model_args") or {},
+                options=body.get("options") or {},
+                spawn=spawn,
+                priority=body.get("priority") or 0,
+                deadline_s=body.get("deadline_s"),
+                tenant=body.get("tenant"),
+                hbm_budget_mib=body.get("hbm_budget_mib"),
+            )
+        except (ValueError, RuntimeError) as e:
+            _json_response(self, {"error": str(e)}, 400)
+            return
+        _json_response(
+            self, {"job_id": handle.job_id, **handle.status()}, 201
+        )
+
+    # -- static UI (the Explorer page; its job panel polls /jobs) -----------
+
+    def _static(self, path: str) -> None:
+        asset = ui_asset(path)
+        if asset is None:
+            _json_response(self, {"error": "not found"}, 404)
+            return
+        content_type, body = asset
+        _send(self, body, content_type)
+
+
+class ServiceServer:
+    """``CheckService`` + HTTP on a daemon thread.
+
+    ::
+
+        server = ServiceServer(port=8791)       # owns a fresh service
+        ... curl -X POST :8791/jobs -d '{"model": "2pc"}' ...
+        server.close()
+
+    Pass an existing ``service=`` to front it without owning its
+    lifecycle (``close()`` then leaves the service running)."""
+
+    def __init__(self, service: Optional[CheckService] = None, port: int = 0,
+                 host: str = "127.0.0.1", run_id: Optional[str] = None,
+                 **service_kwargs):
+        self._owns_service = service is None
+        self.service = (
+            service if service is not None else CheckService(**service_kwargs)
+        )
+        # Aggregate monitor core (no run filter): every job's wave spans
+        # feed one estimator — the whole-device states/s view.
+        self.core = MonitorCore(run_id=run_id)
+        try:
+            handler = type(
+                "Handler",
+                (_ServiceHandler,),
+                {"service": self.service, "core": self.core},
+            )
+            self._server = ThreadingHTTPServer((host, port), handler)
+        except BaseException:
+            self.core.close()
+            if self._owns_service:
+                self.service.close()
+            raise
+        self._server.daemon_threads = True
+        self.host, self.port = self._server.server_address[:2]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="service-http",
+            daemon=True,
+        )
+        self._thread.start()
+        self.core.tracer.instant(
+            "service.started", port=self.port, run_id=self.core.run_id
+        )
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def close(self) -> None:
+        self.core.close()
+        self._server.shutdown()
+        self._server.server_close()
+        self._thread.join(timeout=5.0)
+        if self._owns_service:
+            self.service.close()
+
+    def __enter__(self) -> "ServiceServer":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.close()

@@ -1,0 +1,253 @@
+"""Job objects for the check service: the admission-queue entry and the
+caller-facing handle.
+
+A job's lifecycle::
+
+    queued ──schedule──▶ running ──complete──▶ done
+       ▲                    │ │────fail──────▶ failed
+       │                    │ │────cancel────▶ cancelled
+       └─────suspended ◀────┘ (preempted at a wave boundary; the
+             checkpoint payload re-enters the queue)
+
+All mutation happens on the scheduler thread; readers (``status()``, the
+HTTP front-end) take the job lock only for the multi-field snapshots so a
+mid-transition read never mixes two states' fields.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+JOB_QUEUED = "queued"
+JOB_RUNNING = "running"
+JOB_SUSPENDED = "suspended"
+JOB_DONE = "done"
+JOB_FAILED = "failed"
+JOB_CANCELLED = "cancelled"
+
+_TERMINAL = (JOB_DONE, JOB_FAILED, JOB_CANCELLED)
+
+
+class CheckJob:
+    """One submitted check: the model factory + builder options + spawn
+    kwargs, the tenant's scheduling class (``priority`` high-first,
+    ``deadline_s`` earliest-first within a priority, FIFO within a
+    deadline), the per-tenant ``hbm_budget_mib``, and the run state the
+    scheduler threads through preempt/resume cycles."""
+
+    def __init__(
+        self,
+        job_id: str,
+        model_factory: Callable,
+        *,
+        model_name: Optional[str] = None,
+        options: Optional[dict] = None,
+        spawn: Optional[dict] = None,
+        priority: int = 0,
+        deadline_s: Optional[float] = None,
+        tenant: Optional[str] = None,
+        hbm_budget_mib: Optional[float] = None,
+        aot_namespace: Optional[str] = None,
+        seq: int = 0,
+        clock=time.monotonic,
+    ):
+        self.job_id = job_id
+        self.run_id = job_id
+        self.model_factory = model_factory
+        self.model_name = model_name
+        self.options = dict(options or {})
+        self.spawn = dict(spawn or {})
+        self.priority = int(priority)
+        self.deadline_s = deadline_s
+        self.tenant = tenant
+        self.hbm_budget_mib = hbm_budget_mib
+        self.aot_namespace = aot_namespace
+        self.seq = seq
+        self._clock = clock
+        self._lock = threading.Lock()
+
+        self.state = JOB_QUEUED
+        self.payload: Optional[dict] = None  # suspended checkpoint
+        self.result: Optional[dict] = None
+        self.error: Optional[str] = None
+        self.preempts = 0
+        self.slices = 0
+        self.active_s = 0.0  # device-holding wall across slices
+        self.warmup_s = 0.0  # summed compile warmup across incarnations
+        self.submitted_t = clock()
+        # Round-robin clock: a slice bumps it, so within one
+        # (priority, deadline) class the scheduler always picks the
+        # least-recently-run job — preempting a job only to re-pick it
+        # would be pure checkpoint/restore churn.
+        self.last_run_t = self.submitted_t
+        self.started_t: Optional[float] = None
+        self.first_discovery_t: Optional[float] = None
+        self.finished_t: Optional[float] = None
+        self.seen_discoveries: set = set()
+        self.cancel_event = threading.Event()
+        self.done_event = threading.Event()
+
+    # -- scheduler-side helpers --------------------------------------------
+
+    def sort_key(self, last_run_override=None):
+        """Admission order: priority high-first, then earliest absolute
+        deadline, then round-robin (least recently run; FIFO among
+        never-run jobs, whose clock is their submission time).
+        ``last_run_override`` evaluates the key as if the job had just
+        run — the quantum-expiry preemption test compares peers against
+        the running job's REENTRY position with this, so the two always
+        use one key shape."""
+        deadline = (
+            self.submitted_t + self.deadline_s
+            if self.deadline_s is not None
+            else float("inf")
+        )
+        last_run = (
+            self.last_run_t if last_run_override is None else last_run_override
+        )
+        return (-self.priority, deadline, last_run, self.seq)
+
+    def runnable(self) -> bool:
+        return self.state in (JOB_QUEUED, JOB_SUSPENDED)
+
+    def finish(self, state: str) -> None:
+        with self._lock:
+            self.state = state
+            self.finished_t = self._clock()
+        self.done_event.set()
+
+    # State transitions take the job lock so a concurrent status() never
+    # reads mixed fields (e.g. state "running" with a verdict attached).
+
+    def suspend(self, payload: dict) -> None:
+        with self._lock:
+            self.payload = payload
+            self.preempts += 1
+            self.state = JOB_SUSPENDED
+
+    def complete(self, result: dict) -> None:
+        # Verdict and terminal state land under ONE lock acquisition —
+        # a reader must never see state "running" with a result attached.
+        with self._lock:
+            self.result = result
+            self.state = JOB_DONE
+            self.finished_t = self._clock()
+        self.done_event.set()
+
+    def fail(self, error: str) -> None:
+        with self._lock:
+            self.error = error
+            self.payload = None
+            self.state = JOB_FAILED
+            self.finished_t = self._clock()
+        self.done_event.set()
+
+    # -- views --------------------------------------------------------------
+
+    def latency(self) -> Dict[str, Optional[float]]:
+        """The latency block every status/bench record carries:
+        ``queued_s`` (submit -> first schedule), ``ttfv_s`` (submit ->
+        first property discovery — time-to-first-violation for
+        falsifiable workloads, time-to-first-witness for ``sometimes``),
+        ``wall_s`` (submit -> terminal state, live runs: so far), and
+        ``active_s`` (device-holding time across slices)."""
+        now = self._clock()
+        end = self.finished_t if self.finished_t is not None else now
+        return {
+            # A never-scheduled job's queue wait ends at its terminal
+            # time (a cancelled-while-queued job must not report a
+            # forever-growing queued_s).
+            "queued_s": (self.started_t or end) - self.submitted_t,
+            "ttfv_s": (
+                self.first_discovery_t - self.submitted_t
+                if self.first_discovery_t is not None
+                else None
+            ),
+            "wall_s": end - self.submitted_t,
+            "active_s": self.active_s,
+        }
+
+    def status(self) -> dict:
+        with self._lock:
+            out = {
+                "job_id": self.job_id,
+                "run_id": self.run_id,
+                "model": self.model_name,
+                "tenant": self.tenant,
+                "priority": self.priority,
+                "deadline_s": self.deadline_s,
+                "hbm_budget_mib": self.hbm_budget_mib,
+                "state": self.state,
+                "preempts": self.preempts,
+                "slices": self.slices,
+                "discoveries_so_far": sorted(self.seen_discoveries),
+                "latency": self.latency(),
+                "result": self.result,
+                "error": self.error,
+            }
+        return out
+
+    # The scalar result fields the job-list view keeps; the heavy ones
+    # (golden report text, attribution/coverage ledgers, per-discovery
+    # detail) stay on the single-job view.
+    _SUMMARY_RESULT_FIELDS = (
+        "unique", "states", "max_depth", "properties_hold", "rate",
+    )
+
+    def summary(self) -> dict:
+        """``status()`` minus the heavy result payload — what the
+        ``GET /jobs`` listing (polled every ~2s by the UI panel)
+        actually renders. Full verdicts stay on ``GET /jobs/<id>``."""
+        out = self.status()
+        result = out.get("result")
+        if isinstance(result, dict):
+            out["result"] = {
+                k: result.get(k) for k in self._SUMMARY_RESULT_FIELDS
+            }
+        return out
+
+
+class JobHandle:
+    """The caller's view of a submitted job (the Python-API surface the
+    HTTP front-end mirrors)."""
+
+    def __init__(self, job: CheckJob, service):
+        self._job = job
+        self._service = service
+
+    @property
+    def job_id(self) -> str:
+        return self._job.job_id
+
+    def done(self) -> bool:
+        return self._job.done_event.is_set()
+
+    def status(self) -> dict:
+        return self._job.status()
+
+    def cancel(self) -> bool:
+        """Requests cancellation; True unless the job already reached a
+        terminal state. A running job is preempted at its next wave
+        boundary and its payload discarded."""
+        if self._job.state in _TERMINAL:
+            return False
+        self._job.cancel_event.set()
+        self._service._wake()
+        return True
+
+    def result(self, timeout: Optional[float] = None) -> dict:
+        """Blocks for the verdict. Raises ``TimeoutError`` on timeout,
+        ``RuntimeError`` for a failed or cancelled job."""
+        if not self._job.done_event.wait(timeout):
+            raise TimeoutError(
+                f"job {self._job.job_id} not done within {timeout}s"
+            )
+        if self._job.state == JOB_FAILED:
+            raise RuntimeError(
+                f"job {self._job.job_id} failed: {self._job.error}"
+            )
+        if self._job.state == JOB_CANCELLED:
+            raise RuntimeError(f"job {self._job.job_id} was cancelled")
+        return self._job.result

@@ -1,0 +1,523 @@
+"""The persistent check scheduler: one device, many jobs.
+
+``CheckService`` owns the accelerator the way a database owns its disk: a
+scheduler thread admits :class:`CheckJob` s (priority high-first, EDF
+within a priority, FIFO within a deadline) and time-slices the device
+between them at **wave granularity** — a running job is suspended by
+``TpuBfsChecker.request_preempt()`` (its wave state drains to a host-side
+checkpoint payload at the next wave/drain boundary) and resumed later by
+spawning a new checker with ``resume_from=<payload>``; the resumed run is
+bit-identical to an uninterrupted one (counts, depths, discoveries,
+golden reporter — tests/test_preempt_resume.py).
+
+Jobs multiplex onto the shared AOT rung cache (``checker/tpu.py``'s
+``shared_aot_cache``): two jobs of the same zoo configuration share every
+``(bucket, table_capacity)`` wave/drain executable, so the second job —
+and every preempted job's next incarnation — records zero compile phases
+in its attribution ledger. Each job runs under its own ``run_id``: its
+own metrics registry and run-stamped trace spans, so per-job ``/metrics``
+/ ``/status`` / SSE / attribution / coverage all work (PR 3-8 plumbing).
+
+Single-device by design: slices are strictly serialized, so the device
+never has two claimants (the same constraint the bench's sentinel
+coordination enforces across processes, here enforced by the scheduler
+loop within one).
+"""
+
+from __future__ import annotations
+
+import io
+import itertools
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from ..report import WriteReporter
+from .jobs import (
+    JOB_CANCELLED,
+    JOB_DONE,
+    JOB_FAILED,
+    JOB_QUEUED,
+    JOB_RUNNING,
+    JOB_SUSPENDED,
+    CheckJob,
+    JobHandle,
+)
+from .zoo import aot_namespace as zoo_namespace
+from .zoo import default_zoo
+
+# Builder options POST /jobs and submit(options=...) accept.
+_BUILDER_OPTIONS = ("target_state_count", "target_max_depth", "symmetry")
+
+# Spawn kwargs the service defaults for every job: a bounded drain cap is
+# what makes preemption latency a few waves instead of a whole drain (the
+# same clamp checkpoint durability applies), and modest capacities fit
+# many tenants on one device.
+_DEFAULT_SPAWN = {
+    "frontier_capacity": 1 << 10,
+    "table_capacity": 1 << 16,
+    "max_drain_waves": 8,
+}
+
+# Default job ids are unique across every service in the process (the
+# id is also the run_id, which keys process-global registries).
+_GLOBAL_JOB_SEQ = itertools.count()
+
+
+class CheckService:
+    """A long-lived, in-process checking service.
+
+    ::
+
+        svc = CheckService()
+        h1 = svc.submit(model_name="2pc", model_args={"rm_count": 5})
+        h2 = svc.submit(model_name="abd", priority=1)   # runs first
+        print(h1.result()["unique"], h1.status()["latency"]["ttfv_s"])
+        svc.close()
+
+    ``quantum_s`` is the scheduling quantum: a running job is preempted
+    once its slice exceeds it *and* another job is runnable (a sole job
+    runs uninterrupted — preemption exists for sharing, not ceremony).
+    ``default_hbm_budget_mib`` is the per-tenant device budget applied to
+    jobs that don't set their own (the PR 5 tiered store enforces it).
+    """
+
+    def __init__(
+        self,
+        *,
+        quantum_s: float = 1.0,
+        poll_interval_s: float = 0.005,
+        zoo: Optional[Dict[str, Callable]] = None,
+        default_spawn: Optional[dict] = None,
+        default_hbm_budget_mib: Optional[float] = None,
+        spawn_method: str = "spawn_tpu_bfs",
+        max_finished_jobs: int = 256,
+        clock=time.monotonic,
+    ):
+        self.quantum_s = float(quantum_s)
+        self.poll_interval_s = float(poll_interval_s)
+        self.zoo = dict(zoo) if zoo is not None else default_zoo()
+        self.default_spawn = dict(_DEFAULT_SPAWN)
+        if default_spawn:
+            self.default_spawn.update(default_spawn)
+        self.default_hbm_budget_mib = default_hbm_budget_mib
+        self.spawn_method = spawn_method
+        # Retention: terminal jobs (and their run registries) beyond
+        # this count are evicted oldest-first, so a long-lived service
+        # does not accrete one registry + result blob per finished job
+        # forever. Live JobHandles keep working — they hold the job
+        # object, not the index entry.
+        self.max_finished_jobs = max(0, int(max_finished_jobs))
+        self._clock = clock
+        self._cond = threading.Condition()
+        self._jobs: Dict[str, CheckJob] = {}
+        self._seq = itertools.count()
+        self._closing = threading.Event()
+        self._active_checker = None
+        self._scheduler = threading.Thread(
+            target=self._run_scheduler, name="check-service", daemon=True
+        )
+        self._scheduler.start()
+
+    # -- submission ---------------------------------------------------------
+
+    def submit(
+        self,
+        model=None,
+        *,
+        model_name: Optional[str] = None,
+        model_args: Optional[dict] = None,
+        options: Optional[dict] = None,
+        spawn: Optional[dict] = None,
+        priority: int = 0,
+        deadline_s: Optional[float] = None,
+        tenant: Optional[str] = None,
+        hbm_budget_mib: Optional[float] = None,
+        aot_namespace: Optional[str] = None,
+        job_id: Optional[str] = None,
+    ) -> JobHandle:
+        """Admits one check job; returns immediately with a handle.
+
+        Either ``model_name`` (a zoo entry; ``model_args`` forwarded to
+        its factory — this route shares the AOT cache automatically) or
+        ``model`` (a ``BatchableModel`` instance or zero-arg factory;
+        pass ``aot_namespace=`` yourself iff submissions under that
+        namespace are configured identically). ``options`` takes the
+        builder knobs (``target_state_count``, ``target_max_depth``,
+        ``symmetry``); ``spawn`` any ``spawn_tpu_bfs`` kwarg;
+        ``hbm_budget_mib`` the tenant's device budget."""
+        if self._closing.is_set():
+            raise RuntimeError("CheckService is closed")
+        for field_name, value in (
+            ("model_args", model_args),
+            ("options", options),
+            ("spawn", spawn),
+        ):
+            if value is not None and not isinstance(value, dict):
+                raise ValueError(
+                    f"{field_name} must be an object/dict, "
+                    f"got {type(value).__name__}"
+                )
+        model_args = dict(model_args or {})
+        if model_name is not None:
+            if model is not None:
+                raise ValueError("pass model or model_name, not both")
+            try:
+                factory_fn = self.zoo[model_name]
+            except KeyError:
+                raise ValueError(
+                    f"unknown model {model_name!r} "
+                    f"(zoo has: {sorted(self.zoo)})"
+                ) from None
+            def factory(fn=factory_fn, kw=model_args):
+                return fn(**kw)
+            if aot_namespace is None:
+                # Canonicalize zoo aliases ("2pc"/"two_phase_commit" map
+                # to one factory): namespace on the factory's first zoo
+                # name, so aliases share the executable cache instead of
+                # recompiling per spelling.
+                canonical = min(
+                    k for k, v in self.zoo.items() if v is factory_fn
+                )
+                aot_namespace = zoo_namespace(canonical, model_args)
+        elif model is not None:
+            if callable(model) and not hasattr(model, "packed_init_states"):
+                factory = model
+            else:
+                def factory(m=model):
+                    return m
+        else:
+            raise ValueError("one of model / model_name is required")
+        bad = set(options or {}) - set(_BUILDER_OPTIONS)
+        if bad:
+            raise ValueError(
+                f"unknown options {sorted(bad)} "
+                f"(supported: {list(_BUILDER_OPTIONS)})"
+            )
+        # Coerce the scheduling inputs HERE, not in the scheduler: a
+        # non-numeric deadline from an HTTP body reaching sort_key()
+        # would kill the scheduler thread and hang every job.
+        try:
+            priority = int(priority)
+            deadline_s = None if deadline_s is None else float(deadline_s)
+            hbm_budget_mib = (
+                None if hbm_budget_mib is None else float(hbm_budget_mib)
+            )
+        except (TypeError, ValueError) as e:
+            raise ValueError(
+                "priority must be an int; deadline_s / hbm_budget_mib "
+                f"must be numbers or null ({e})"
+            ) from None
+        if hbm_budget_mib is None:
+            hbm_budget_mib = self.default_hbm_budget_mib
+        with self._cond:
+            seq = next(self._seq)
+            # Default ids draw from the PROCESS-global sequence, not the
+            # per-service one: the id doubles as the run_id keying the
+            # process-global metrics registries, so two services in one
+            # process (common in tests, possible in embedders) must
+            # never mint the same "job-0" and merge two jobs' counters.
+            jid = job_id or f"job-{next(_GLOBAL_JOB_SEQ)}"
+            if jid in self._jobs:
+                raise ValueError(f"duplicate job_id {jid!r}")
+            job = CheckJob(
+                jid,
+                factory,
+                model_name=model_name,
+                options=options,
+                spawn=spawn,
+                priority=priority,
+                deadline_s=deadline_s,
+                tenant=tenant,
+                hbm_budget_mib=hbm_budget_mib,
+                aot_namespace=aot_namespace,
+                seq=seq,
+                clock=self._clock,
+            )
+            self._jobs[jid] = job
+            self._cond.notify_all()
+        return JobHandle(job, self)
+
+    # -- introspection ------------------------------------------------------
+
+    def job(self, job_id: str) -> Optional[CheckJob]:
+        with self._cond:
+            return self._jobs.get(job_id)
+
+    def jobs(self) -> List[CheckJob]:
+        with self._cond:
+            return sorted(self._jobs.values(), key=lambda j: j.seq)
+
+    def status(self) -> dict:
+        js = self.jobs()
+        return {
+            "quantum_s": self.quantum_s,
+            "closing": self._closing.is_set(),
+            "jobs": [j.status() for j in js],
+            "counts": {
+                state: sum(1 for j in js if j.state == state)
+                for state in (
+                    JOB_QUEUED, JOB_RUNNING, JOB_SUSPENDED,
+                    JOB_DONE, JOB_FAILED, JOB_CANCELLED,
+                )
+            },
+        }
+
+    def _wake(self) -> None:
+        with self._cond:
+            self._cond.notify_all()
+
+    # -- the scheduler loop -------------------------------------------------
+
+    def _pick(self) -> Optional[CheckJob]:
+        """Highest-priority runnable job (the admission order
+        ``CheckJob.sort_key``); reaps cancelled queued jobs in passing.
+        Caller holds the condition lock."""
+        best = None
+        for job in self._jobs.values():
+            if not job.runnable():
+                continue
+            if job.cancel_event.is_set():
+                job.payload = None
+                job.finish(JOB_CANCELLED)
+                continue
+            if best is None or job.sort_key() < best.sort_key():
+                best = job
+        return best
+
+    def _should_preempt_for_peer(self, current: CheckJob) -> bool:
+        """Whether suspending the current job at quantum expiry would
+        actually hand the device to someone else: some other runnable
+        job must sort AHEAD of where the current job would re-enter the
+        queue (its round-robin clock stamped to "just ran"). Comparing
+        the real sort keys — not just priority — keeps EDF jobs honest
+        too: a finite-deadline job sorts first within its class
+        regardless of recency, so a priority-only guard would preempt
+        it every quantum only to re-pick it (pure checkpoint/restore
+        churn) while its peers starve behind the respawn overhead."""
+        current_key = current.sort_key(last_run_override=self._clock())
+        with self._cond:
+            return any(
+                j is not current
+                and j.runnable()
+                and not j.cancel_event.is_set()
+                and j.sort_key() < current_key
+                for j in self._jobs.values()
+            )
+
+    def _run_scheduler(self) -> None:
+        while True:
+            with self._cond:
+                job = self._pick()
+                while job is None and not self._closing.is_set():
+                    self._cond.wait(timeout=0.5)
+                    job = self._pick()
+                if self._closing.is_set():
+                    return
+            try:
+                self._run_slice(job)
+            except Exception as e:  # noqa: BLE001 - a job must not kill the loop
+                job.fail(repr(e))
+            self._evict_finished()
+
+    def _spawn(self, job: CheckJob):
+        model = job.model_factory()
+        builder = model.checker()
+        opts = job.options
+        if opts.get("target_state_count"):
+            builder = builder.target_state_count(opts["target_state_count"])
+        if opts.get("target_max_depth"):
+            builder = builder.target_max_depth(opts["target_max_depth"])
+        if opts.get("symmetry"):
+            builder = builder.symmetry()
+        spawn = dict(self.default_spawn)
+        spawn.update(job.spawn)
+        spawn["run_id"] = job.run_id
+        # Cross-job executable sharing is a single-device-checker
+        # feature for now (the sharded checker has no aot_cache knob);
+        # passing it unconditionally would TypeError every job under
+        # spawn_method="spawn_sharded_tpu_bfs".
+        if (
+            job.aot_namespace is not None
+            and self.spawn_method == "spawn_tpu_bfs"
+        ):
+            spawn.setdefault("aot_cache", job.aot_namespace)
+        if job.hbm_budget_mib is not None:
+            spawn.setdefault("hbm_budget_mib", job.hbm_budget_mib)
+        if job.payload is not None:
+            spawn["resume_from"] = job.payload
+            job.payload = None
+        return getattr(builder, self.spawn_method)(**spawn)
+
+    def _poll_discoveries(self, job: CheckJob, checker) -> None:
+        try:
+            names = set(checker._discovery_names())
+        except Exception:  # noqa: BLE001 - mid-run best effort
+            return
+        fresh = names - job.seen_discoveries
+        if fresh:
+            job.seen_discoveries |= names
+            if job.first_discovery_t is None:
+                job.first_discovery_t = self._clock()
+
+    def _run_slice(self, job: CheckJob) -> None:
+        """One scheduling slice: (re)spawn the job's checker, let it run
+        for up to a quantum (to completion when nothing else wants the
+        device), then preempt/harvest. Strictly serialized — the device
+        has exactly one claimant at any time."""
+        job.state = JOB_RUNNING
+        job.slices += 1
+        t0 = self._clock()
+        if job.started_t is None:
+            job.started_t = t0
+        try:
+            checker = self._spawn(job)
+        except Exception as e:  # noqa: BLE001 - bad knobs/model = job failure
+            job.fail(repr(e))
+            return
+        self._active_checker = checker
+        # On resume, the restored discoveries must not count as "first".
+        self._poll_discoveries(job, checker)
+        slice_end = t0 + self.quantum_s
+
+        # A backend without preemption support (host engines raise
+        # NotImplementedError from the base request_preempt) degrades
+        # gracefully: its slice simply runs to completion — failing the
+        # job while its worker threads live on would leave TWO checkers
+        # claiming the device once the scheduler moved on.
+        def try_preempt() -> bool:
+            try:
+                checker.request_preempt()
+                return True
+            except NotImplementedError:
+                return False
+
+        preempting = False
+        preemptible = True
+        try:
+            while not checker.is_done():
+                if (job.cancel_event.is_set() or self._closing.is_set()) \
+                        and not preempting and preemptible:
+                    preemptible = preempting = try_preempt()
+                elif (
+                    not preempting
+                    and preemptible
+                    and self._clock() >= slice_end
+                    and self._should_preempt_for_peer(job)
+                ):
+                    preemptible = preempting = try_preempt()
+                self._poll_discoveries(job, checker)
+                time.sleep(self.poll_interval_s)
+            for h in checker.handles():
+                h.join()
+            self._poll_discoveries(job, checker)
+        finally:
+            self._active_checker = None
+            job.active_s += self._clock() - t0
+            job.last_run_t = self._clock()
+            job.warmup_s += getattr(checker, "warmup_seconds", None) or 0.0
+        err = checker.worker_error()
+        if err is not None:
+            job.fail(repr(err))
+            return
+        if job.cancel_event.is_set():
+            job.finish(JOB_CANCELLED)
+            return
+        if checker.preempted:
+            job.suspend(checker.preempt_payload())
+            return
+        job.complete(self._finalize(job, checker))
+
+    def _evict_finished(self) -> None:
+        """Drops the oldest terminal jobs (and their run registries)
+        past the retention cap. Suspended/queued/running jobs are never
+        evicted."""
+        from ..telemetry import discard_run_registry
+
+        with self._cond:
+            finished = sorted(
+                (
+                    j
+                    for j in self._jobs.values()
+                    if j.state in (JOB_DONE, JOB_FAILED, JOB_CANCELLED)
+                ),
+                key=lambda j: j.finished_t or 0.0,
+            )
+            excess = finished[: max(0, len(finished) - self.max_finished_jobs)]
+            for j in excess:
+                del self._jobs[j.job_id]
+        for j in excess:
+            discard_run_registry(j.run_id)
+
+    def _finalize(self, job: CheckJob, checker) -> dict:
+        """The completed job's verdict record (the bench's per-job row)."""
+        unique = checker.unique_state_count()
+        discoveries = {}
+        try:
+            for name, path in checker.discoveries().items():
+                discoveries[name] = {
+                    "classification": checker.discovery_classification(name),
+                    "length": len(path),
+                }
+        except Exception as e:  # noqa: BLE001 - verdicts above all
+            discoveries = {"error": repr(e)}
+        try:
+            checker.assert_properties()
+            properties_hold = True
+        except AssertionError:
+            properties_hold = False
+        out = io.StringIO()
+        try:
+            checker.report(WriteReporter(out))
+        except Exception:  # noqa: BLE001
+            pass
+        steady = max(job.active_s - job.warmup_s, 1e-9)
+        result = {
+            "unique": unique,
+            "states": checker.state_count(),
+            "max_depth": checker.max_depth(),
+            "discoveries": discoveries,
+            "properties_hold": properties_hold,
+            "report": out.getvalue(),
+            "warmup_s": job.warmup_s,
+            "rate": unique / steady,
+        }
+        attribution = checker.attribution_report()
+        if attribution is not None:
+            result["attribution"] = attribution
+            # Compile seconds ACROSS incarnations: the final checker's
+            # ledger only covers its own life, but the per-run registry's
+            # `*.pipeline.compile_seconds` counters persist through
+            # preempt/resume cycles — the honest shared-AOT-cache
+            # evidence (a job that compiled in slice 1 and finished in a
+            # cache-hitting slice 3 is NOT compile-free).
+            try:
+                snap = checker.metrics().snapshot()
+                result["compile_s_total"] = sum(
+                    v
+                    for k, v in snap.items()
+                    if k.endswith(".pipeline.compile_seconds")
+                    and isinstance(v, (int, float))
+                )
+            except Exception:  # noqa: BLE001 - evidence, not verdict
+                pass
+        cov = checker.coverage_report()
+        if cov is not None:
+            result["coverage"] = cov
+        return result
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def close(self, timeout: Optional[float] = 30.0) -> None:
+        """Stops the scheduler: the running slice (if any) is preempted
+        at its next wave boundary and left suspended, queued jobs stay
+        queued. Idempotent."""
+        self._closing.set()
+        self._wake()
+        self._scheduler.join(timeout=timeout)
+
+    def __enter__(self) -> "CheckService":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.close()

@@ -200,6 +200,7 @@ class TieredVisitedStore:
         instruments: Optional[StorageInstruments] = None,
         prefix: str = "tpu_bfs",
         shard: Optional[int] = None,
+        tracer=None,
     ):
         if host_budget_mib is not None and spill_dir is None:
             raise ValueError(
@@ -219,7 +220,9 @@ class TieredVisitedStore:
             else StorageInstruments(prefix)
         )
         self._instr.attach(self)
-        self._tracer = get_tracer()
+        # A run-scoped tracer (checkers spawned with run_id=) stamps the
+        # evict/merge/spill spans with the run id; default otherwise.
+        self._tracer = tracer if tracer is not None else get_tracer()
         self._span_prefix = self._instr.prefix
         self._shard = shard
         self._seq = 0

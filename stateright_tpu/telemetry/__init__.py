@@ -29,10 +29,13 @@ from .metrics import (
     Gauge,
     Histogram,
     MetricsRegistry,
+    discard_run_registry,
     metrics_registry,
+    run_registries,
 )
 from .trace import (
     JsonlSink,
+    RunScopedTracer,
     Tracer,
     chrome_trace,
     chrome_trace_from_jsonl,
@@ -55,6 +58,7 @@ _SERVER_SYMBOLS = frozenset({
     "ProgressEstimator",
     "StallWatchdog",
     "prometheus_text",
+    "prometheus_text_all_runs",
     "registry_hygiene_problems",
 })
 
@@ -82,6 +86,7 @@ __all__ = [
     "MonitorCore",
     "MonitorServer",
     "ProgressEstimator",
+    "RunScopedTracer",
     "StallWatchdog",
     "Tracer",
     "WaveAttribution",
@@ -90,11 +95,14 @@ __all__ = [
     "chrome_trace_from_jsonl",
     "device_annotation",
     "device_step_annotation",
+    "discard_run_registry",
     "get_tracer",
     "instant",
     "metrics_registry",
     "prometheus_text",
+    "prometheus_text_all_runs",
     "registry_hygiene_problems",
+    "run_registries",
     "span",
     "write_chrome_trace",
 ]

@@ -184,7 +184,43 @@ class MetricsRegistry:
 
 _default_registry = MetricsRegistry()
 
+# Per-run registries, keyed by run_id (the multi-tenant namespacing fix:
+# two checkers in one process previously collided on every instrument —
+# `tpu_bfs.waves` counted both runs' waves and the gauges flapped between
+# them). A checker spawned with ``run_id=`` records into its own registry;
+# the default (run_id=None) stays THE process-local registry, so
+# single-run processes and every existing caller are unchanged.
+_run_lock = threading.Lock()
+_run_registries: Dict[str, MetricsRegistry] = {}
 
-def metrics_registry() -> MetricsRegistry:
-    """THE process-local registry every backend records into."""
-    return _default_registry
+
+def metrics_registry(run_id: Optional[str] = None) -> MetricsRegistry:
+    """The process-local registry every backend records into, or — given
+    a ``run_id`` — that run's own registry (created on first use). Run
+    registries isolate concurrent checkers' instruments; drop them with
+    ``discard_run_registry`` when the run's numbers are no longer
+    needed (a long-lived service would otherwise accrete one registry
+    per finished job)."""
+    if run_id is None:
+        return _default_registry
+    reg = _run_registries.get(run_id)
+    if reg is None:
+        with _run_lock:
+            reg = _run_registries.get(run_id)
+            if reg is None:
+                reg = MetricsRegistry()
+                _run_registries[run_id] = reg
+    return reg
+
+
+def run_registries() -> Dict[str, MetricsRegistry]:
+    """Snapshot of the per-run registries (``{run_id: registry}``) — the
+    monitor's aggregate view iterates this to export every live run."""
+    with _run_lock:
+        return dict(_run_registries)
+
+
+def discard_run_registry(run_id: str) -> None:
+    """Forgets one run's registry (no-op when absent)."""
+    with _run_lock:
+        _run_registries.pop(run_id, None)

@@ -73,8 +73,23 @@ def sanitize_metric_name(name: str, prefix: str = "stateright") -> str:
     return out
 
 
+def _label_str(labels: Optional[Dict[str, str]], extra: str = "") -> str:
+    """Renders a label set (plus an optional pre-rendered ``k="v"`` pair
+    like a histogram's ``le``) as the ``{...}`` suffix, or ``""``."""
+    parts = []
+    if labels:
+        for k, v in sorted(labels.items()):
+            v = str(v).replace("\\", "\\\\").replace('"', '\\"')
+            parts.append(f'{k}="{v}"')
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
 def prometheus_text(registry: MetricsRegistry = None,
-                    prefix: str = "stateright") -> str:
+                    prefix: str = "stateright",
+                    labels: Optional[Dict[str, str]] = None,
+                    _seen_types: Optional[set] = None) -> str:
     """The full registry in Prometheus text exposition format (0.0.4).
 
     Counters gain the conventional ``_total`` suffix; gauges keep their
@@ -83,36 +98,68 @@ def prometheus_text(registry: MetricsRegistry = None,
     ``tpu_bfs.warmup_seconds``, ``*.storage.host_bytes``); log2
     histograms render as cumulative ``le``-bucketed histograms with
     ``_sum``/``_count``. Unset gauges are elided rather than exported as
-    fake zeros."""
+    fake zeros. ``labels`` attaches a constant label set to every series
+    — the multi-run aggregate view exports each run's registry under a
+    ``run_id`` label so same-named series never merge."""
     reg = registry if registry is not None else metrics_registry()
+    lab = _label_str(labels)
+    # Spec: at most one TYPE line per metric family. The multi-run
+    # aggregate threads one `_seen_types` set through every registry so
+    # same-named series from different runs share a single TYPE line.
+    seen = _seen_types if _seen_types is not None else set()
+
+    def type_line(lines, pname, kind):
+        if pname not in seen:
+            seen.add(pname)
+            lines.append(f"# TYPE {pname} {kind}")
+
     lines: List[str] = []
     for name, inst in reg.instruments():
         if isinstance(inst, Counter):
             pname = sanitize_metric_name(name, prefix) + "_total"
-            lines.append(f"# TYPE {pname} counter")
-            lines.append(f"{pname} {_fmt_value(inst.snapshot())}")
+            type_line(lines, pname, "counter")
+            lines.append(f"{pname}{lab} {_fmt_value(inst.snapshot())}")
         elif isinstance(inst, Gauge):
             value = inst.snapshot()
             if value is None:
                 continue
             pname = sanitize_metric_name(name, prefix)
-            lines.append(f"# TYPE {pname} gauge")
-            lines.append(f"{pname} {_fmt_value(value)}")
+            type_line(lines, pname, "gauge")
+            lines.append(f"{pname}{lab} {_fmt_value(value)}")
         elif isinstance(inst, Histogram):
             snap = inst.snapshot()
             pname = sanitize_metric_name(name, prefix)
-            lines.append(f"# TYPE {pname} histogram")
+            type_line(lines, pname, "histogram")
             cum = 0
             for i, count in enumerate(snap["buckets_log2"]):
                 cum += count
                 if count:
-                    lines.append(
-                        f'{pname}_bucket{{le="{float(1 << i)}"}} {cum}'
-                    )
-            lines.append(f'{pname}_bucket{{le="+Inf"}} {snap["count"]}')
-            lines.append(f"{pname}_sum {_fmt_value(snap['sum'])}")
-            lines.append(f"{pname}_count {snap['count']}")
+                    le = _label_str(labels, f'le="{float(1 << i)}"')
+                    lines.append(f"{pname}_bucket{le} {cum}")
+            inf = _label_str(labels, 'le="+Inf"')
+            lines.append(f'{pname}_bucket{inf} {snap["count"]}')
+            lines.append(f"{pname}_sum{lab} {_fmt_value(snap['sum'])}")
+            lines.append(f"{pname}_count{lab} {snap['count']}")
     return "\n".join(lines) + "\n"
+
+
+def prometheus_text_all_runs(prefix: str = "stateright") -> str:
+    """The aggregate exposition for a multi-run process: the default
+    registry unlabeled, then every per-run registry
+    (``telemetry.metrics.run_registries``) with a ``run_id`` label —
+    same-named series from different runs stay distinct."""
+    from .metrics import run_registries
+
+    seen_types: set = set()
+    parts = [prometheus_text(prefix=prefix, _seen_types=seen_types)]
+    for run_id, reg in sorted(run_registries().items()):
+        parts.append(
+            prometheus_text(
+                reg, prefix=prefix, labels={"run_id": run_id},
+                _seen_types=seen_types,
+            )
+        )
+    return "".join(parts)
 
 
 def _fmt_value(v) -> str:
@@ -644,11 +691,18 @@ class MonitorCore:
                  tracer: Tracer = None, run_id: Optional[str] = None,
                  stall_deadline_s: Optional[float] = None,
                  stall_capture_dir: Optional[str] = None,
-                 clock=time.monotonic):
+                 clock=time.monotonic, run_filter: Optional[str] = None):
         self.checker = checker
         self.registry = registry if registry is not None else metrics_registry()
         self.tracer = tracer if tracer is not None else get_tracer()
         self.run_id = run_id or _default_run_id()
+        # Per-run selection: with a ``run_filter``, only events stamped
+        # with that ``run_id`` arg (checkers spawned with ``run_id=``
+        # emit through a RunScopedTracer) feed this core — a multi-job
+        # process can run one monitor per job without the jobs' waves
+        # polluting each other's estimators. None = aggregate (default):
+        # every wave from every run feeds the one estimator.
+        self.run_filter = run_filter
         self.estimator = ProgressEstimator(clock=clock)
         # Slow-dashboard drops must be visible to operators, not just an
         # instance attribute: count them in the registry so /metrics and
@@ -731,6 +785,11 @@ class MonitorCore:
             return
         name = event.get("name", "")
         args = event.get("args") or {}
+        if (
+            self.run_filter is not None
+            and args.get("run_id") != self.run_filter
+        ):
+            return
         if "new_unique" in args:
             # Span `frontier` is the DISPATCH width (drains: F_max / G,
             # waves: the padded chunk width) — constant-ish all run. The
@@ -1059,11 +1118,12 @@ class MonitorServer:
                  run_id: Optional[str] = None,
                  stall_deadline_s: Optional[float] = None,
                  stall_capture_dir: Optional[str] = None,
-                 flight_recorder: bool = False, flight_dir: str = "."):
+                 flight_recorder: bool = False, flight_dir: str = ".",
+                 run_filter: Optional[str] = None):
         self.core = MonitorCore(
             checker=checker, registry=registry, tracer=tracer,
             run_id=run_id, stall_deadline_s=stall_deadline_s,
-            stall_capture_dir=stall_capture_dir,
+            stall_capture_dir=stall_capture_dir, run_filter=run_filter,
         )
         self.flight: Optional[FlightRecorder] = None
         try:

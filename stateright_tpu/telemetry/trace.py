@@ -222,12 +222,40 @@ class Tracer:
         self._ring.clear()
 
 
+class RunScopedTracer:
+    """A view of a tracer that stamps ``run_id`` into every span's and
+    instant's args. Checkers spawned with ``run_id=`` emit through one of
+    these, so a multi-run process's interleaved wave spans stay
+    attributable — ``MonitorCore(run_filter=...)`` selects one run's
+    stream, and trace readers can group by ``args.run_id``. Everything
+    else (sinks, ring buffer, enablement) delegates to the wrapped
+    tracer: the events still land in THE process-local stream."""
+
+    def __init__(self, run_id: str, tracer: Optional[Tracer] = None):
+        self.run_id = run_id
+        self._tracer = tracer if tracer is not None else get_tracer()
+
+    def span(self, name: str, **args):
+        args.setdefault("run_id", self.run_id)
+        return self._tracer.span(name, **args)
+
+    def instant(self, name: str, **args) -> None:
+        args.setdefault("run_id", self.run_id)
+        self._tracer.instant(name, **args)
+
+    def __getattr__(self, name):
+        return getattr(self._tracer, name)
+
+
 _default_tracer = Tracer()
 
 
-def get_tracer() -> Tracer:
-    """THE process-local tracer every backend records into."""
-    return _default_tracer
+def get_tracer(run_id: Optional[str] = None):
+    """THE process-local tracer every backend records into; with a
+    ``run_id``, a :class:`RunScopedTracer` view of it."""
+    if run_id is None:
+        return _default_tracer
+    return RunScopedTracer(run_id, _default_tracer)
 
 
 def span(name: str, **args) -> "_Span":

@@ -339,7 +339,55 @@ function startMonitor() {
   };
 }
 
+// ---- job list panel -------------------------------------------------------
+// Fed by the check service's /jobs endpoint (stateright_tpu.service).
+// Hidden unless the serving process actually runs a CheckService — one
+// probe decides, so an Explorer-only or static serve never 404-polls.
+
+async function refreshJobs() {
+  const data = await getJSON("/jobs");
+  const rows = (data.jobs || []).map((j) => {
+    const lat = j.latency || {};
+    const unique =
+      j.result && j.result.unique !== undefined ? j.result.unique : "–";
+    const verdict =
+      j.result === null || j.result === undefined
+        ? ""
+        : j.result.properties_hold
+        ? " ✅"
+        : " ❌";
+    const cancellable = !["done", "failed", "cancelled"].includes(j.state);
+    const btn = cancellable
+      ? `<button class="cancel-job" data-id="${esc(j.job_id)}">✕</button>`
+      : "";
+    return (
+      `<tr class="job-${esc(j.state)}">` +
+      `<td>${esc(j.job_id)}</td><td>${esc(j.model || "")}</td>` +
+      `<td>${esc(j.state)}${verdict}</td><td>${esc(unique)}</td>` +
+      `<td>${lat.ttfv_s == null ? "–" : fmtSecs(lat.ttfv_s)}</td>` +
+      `<td>${lat.wall_s == null ? "–" : fmtSecs(lat.wall_s)}</td>` +
+      `<td>${j.preempts || 0}</td><td>${btn}</td></tr>`
+    );
+  });
+  $("jobs-rows").innerHTML = rows.join("");
+  document.querySelectorAll(".cancel-job").forEach((b) =>
+    b.addEventListener("click", () =>
+      fetch(`/jobs/${b.dataset.id}/cancel`, { method: "POST" })
+        .then(refreshJobs)));
+}
+
+async function startJobs() {
+  try {
+    await refreshJobs();
+  } catch (err) {
+    return; // no /jobs on this server: panel stays hidden
+  }
+  $("jobs-panel").classList.remove("hidden");
+  setInterval(() => refreshJobs().catch(() => {}), 2000);
+}
+
 refreshSteps();
 refreshStatus();
 setInterval(refreshStatus, 1000);
 startMonitor();
+startJobs();

@@ -1,0 +1,148 @@
+"""Direct unit tests for ``checker/job_market.py``'s ``JobBroker``.
+
+Load-bearing for the host engines' worker threads and the service
+admission path, but previously only exercised indirectly through the BFS
+checker. Covers the three contract corners: quiescence close (the last
+idle worker shuts the market down), ``split_and_push`` with zero-size
+pieces (fewer jobs than idle workers must not publish empty batches),
+and the worker-death ``close()`` drain (queued work dropped, blocked
+workers released)."""
+
+import threading
+import time
+from collections import deque
+
+from stateright_tpu.checker.job_market import JobBroker
+
+
+def test_single_thread_quiescence_closes_market():
+    broker = JobBroker(thread_count=1)
+    # The lone worker going idle IS global quiescence: pop returns the
+    # empty "no more jobs" sentinel and the market closes.
+    assert broker.pop() == deque()
+    assert broker.is_closed()
+    # Post-close pops stay empty (no deadlock), pushes are dropped.
+    assert broker.pop() == deque()
+    broker.push(deque([1]))
+    assert broker.pop() == deque()
+    assert broker.is_closed()
+
+
+def test_two_workers_drain_to_quiescence():
+    broker = JobBroker(thread_count=2)
+    broker.push(deque([3, 1]))
+    broker.push(deque([2]))
+    seen = []
+    seen_lock = threading.Lock()
+
+    def worker():
+        while True:
+            batch = broker.pop()
+            if not batch:
+                return
+            with seen_lock:
+                seen.extend(batch)
+
+    threads = [threading.Thread(target=worker) for _ in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=10)
+        assert not t.is_alive(), "worker hung instead of closing"
+    assert sorted(seen) == [1, 2, 3]
+    assert broker.is_closed()
+
+
+def _blocked_worker(broker, results):
+    """A worker parked in pop() (registers as idle) that records what it
+    eventually receives."""
+
+    def run():
+        results.append(broker.pop())
+
+    t = threading.Thread(target=run)
+    t.start()
+    # Wait until the worker is provably idle inside pop() (open_count
+    # decremented) rather than merely started.
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        with broker._cond:
+            if broker._open_count < broker._thread_count:
+                return t
+        time.sleep(0.005)
+    raise AssertionError("worker never went idle")
+
+
+def test_split_and_push_zero_size_pieces_share_nothing():
+    broker = JobBroker(thread_count=2)
+    results = []
+    t = _blocked_worker(broker, results)
+    # One idle thread, one local job: pieces = 2, size = 1 // 2 = 0 —
+    # the zero-size piece must be skipped, never published as an empty
+    # batch that would wake the idle worker with no work.
+    jobs = deque(["only"])
+    broker.split_and_push(jobs)
+    assert list(jobs) == ["only"], "local job must stay local"
+    with broker._cond:
+        assert not broker._job_batches, "no empty batch may be published"
+    broker.close()
+    t.join(timeout=5)
+    assert results == [deque()]
+
+
+def test_split_and_push_shares_surplus_with_idle_worker():
+    broker = JobBroker(thread_count=2)
+    results = []
+    t = _blocked_worker(broker, results)
+    jobs = deque([1, 2, 3, 4])
+    broker.split_and_push(jobs)
+    # pieces = 2, size = 2: half stays local, half goes to the idle
+    # worker (appendleft preserves the shared half's order).
+    assert len(jobs) == 2
+    t.join(timeout=5)
+    assert not t.is_alive()
+    assert len(results) == 1 and len(results[0]) == 2
+    assert sorted(list(jobs) + list(results[0])) == [1, 2, 3, 4]
+
+
+def test_split_and_push_after_close_clears_jobs():
+    broker = JobBroker(thread_count=2)
+    broker.close()
+    jobs = deque([1, 2, 3])
+    broker.split_and_push(jobs)
+    # A dead market takes no work and tells the caller to drop its own:
+    # the local surplus is cleared so the dying worker never grinds on.
+    assert not jobs
+
+
+def test_worker_death_close_releases_blocked_worker():
+    broker = JobBroker(thread_count=2)
+    results = []
+    blocked = _blocked_worker(broker, results)
+    # The other worker "dies" (its exception path calls close(), as the
+    # host engines do in their worker finally blocks): the blocked
+    # worker must drain out with the empty sentinel instead of hanging.
+    broker.close()
+    blocked.join(timeout=5)
+    assert not blocked.is_alive(), "blocked worker not released by close()"
+    assert results == [deque()]
+    # The released worker's own exit path closes too; only then is every
+    # worker accounted for and the market fully closed.
+    broker.close()
+    assert broker.is_closed()
+
+
+def test_worker_death_close_drops_queued_work():
+    broker = JobBroker(thread_count=2)
+    broker.push(deque([1, 2]))
+    broker.push(deque([3]))
+    got = broker.pop()  # worker takes one batch in hand...
+    assert got
+    broker.close()  # ...then dies: the still-queued batch must drop
+    with broker._cond:
+        assert not broker._job_batches, "close() must drop queued work"
+    # The surviving worker's next pop observes the closed market and
+    # exits (then closes itself on the way out).
+    assert broker.pop() == deque()
+    broker.close()
+    assert broker.is_closed()

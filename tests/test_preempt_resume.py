@@ -1,0 +1,252 @@
+"""In-memory checkpoint preempt/resume: a mid-run suspend followed by a
+resume must be BIT-IDENTICAL to the uninterrupted run — state counts,
+unique counts, depths, discovery fingerprints, and the golden
+WriteReporter strings. The argument is the checkpoint-equivalence one
+(tests/test_storage_equivalence.py): ``request_preempt`` drains the run
+through the exact ``checkpoint_payload`` machinery ``save_checkpoint``
+pickles, and ``resume_from=<payload>`` is the exact restore path — only
+the pickle round trip is skipped.
+
+Covers 2pc (materializing pipeline, deep-drain yield), ABD
+(``expand_fps`` pipeline), a double preempt (suspend → resume → suspend
+→ resume), and a suspend that lands mid-L0→L1 eviction (the payload must
+carry the storage tiers)."""
+
+import io
+import math
+import re
+import time
+
+import pytest
+
+from stateright_tpu import WriteReporter
+from stateright_tpu.checker.tpu import TpuBfsChecker
+from stateright_tpu.models.two_phase_commit import TwoPhaseSys
+
+
+def _golden(checker):
+    out = io.StringIO()
+    checker.report(WriteReporter(out))
+    return re.sub(r"sec=\d+", "sec=_", out.getvalue())
+
+
+def _abd_model():
+    from stateright_tpu.models.linearizable_register import AbdModelCfg
+
+    return AbdModelCfg(2, 2).into_model()
+
+
+def _preempt_at(checker, threshold: int, timeout_s: float = 120.0):
+    """Requests preemption once the run has made real progress (so the
+    suspend lands mid-space, not at the seed), then waits the worker
+    out. Returns True when the run actually suspended (a fast run may
+    finish first — callers skip the resume leg then)."""
+    deadline = time.monotonic() + timeout_s
+    while (
+        checker.unique_state_count() < threshold
+        and not checker.is_done()
+        and time.monotonic() < deadline
+    ):
+        time.sleep(0.002)
+    checker.request_preempt()
+    for h in checker.handles():
+        h.join()
+    assert checker.worker_error() is None
+    return checker.preempted
+
+
+def _assert_bit_identical(resumed, reference):
+    assert resumed.worker_error() is None
+    assert reference.worker_error() is None
+    assert resumed.unique_state_count() == reference.unique_state_count()
+    assert resumed.state_count() == reference.state_count()
+    assert resumed.max_depth() == reference.max_depth()
+    assert resumed._discoveries_fp == reference._discoveries_fp
+    assert _golden(resumed) == _golden(reference)
+
+
+# Every 2pc-4 spawn in this module shares one AOT namespace (identical
+# config by construction), so the preempted/resumed incarnations re-use
+# the fixture run's executables instead of re-tracing per incarnation —
+# exactly how the service keeps resumes cheap, and it keeps this module
+# inside the tier-1 time budget.
+SPAWN_2PC4 = {
+    "frontier_capacity": 16,
+    "table_capacity": 1 << 12,
+    "aot_cache": "t-preempt-2pc4",
+}
+
+
+@pytest.fixture(scope="module")
+def uninterrupted_2pc4():
+    checker = (
+        TwoPhaseSys(4).checker().spawn_tpu_bfs(**SPAWN_2PC4).join()
+    )
+    assert checker.worker_error() is None
+    assert checker.unique_state_count() == 1568
+    return checker
+
+
+def test_preempt_resume_2pc_bit_identical(uninterrupted_2pc4):
+    """Deep-drain yield point: suspend mid-space, resume, finish — all
+    run invariants match the uninterrupted run exactly."""
+    first = TwoPhaseSys(4).checker().spawn_tpu_bfs(
+        max_drain_waves=2, **SPAWN_2PC4
+    )
+    if not _preempt_at(first, threshold=200):
+        pytest.skip("run finished before the preempt request landed")
+    assert first.is_done()  # the handle is joinable/reportable
+    assert first.unique_state_count() < 1568
+    payload = first.preempt_payload()
+    assert payload["version"] == 2
+
+    resumed = (
+        TwoPhaseSys(4)
+        .checker()
+        .spawn_tpu_bfs(resume_from=payload, **SPAWN_2PC4)
+        .join()
+    )
+    _assert_bit_identical(resumed, uninterrupted_2pc4)
+    resumed.assert_properties()
+
+
+def test_double_preempt_resume_2pc(uninterrupted_2pc4):
+    """Two suspend/resume cycles (the service's steady state) compose:
+    still bit-identical."""
+    stage = TwoPhaseSys(4).checker().spawn_tpu_bfs(
+        max_drain_waves=2, **SPAWN_2PC4
+    )
+    if not _preempt_at(stage, threshold=150):
+        pytest.skip("run finished before the first preempt")
+    stage2 = TwoPhaseSys(4).checker().spawn_tpu_bfs(
+        max_drain_waves=2, resume_from=stage.preempt_payload(),
+        **SPAWN_2PC4
+    )
+    if not _preempt_at(stage2, threshold=600):
+        pytest.skip("resumed run finished before the second preempt")
+    final = (
+        TwoPhaseSys(4)
+        .checker()
+        .spawn_tpu_bfs(resume_from=stage2.preempt_payload(), **SPAWN_2PC4)
+        .join()
+    )
+    _assert_bit_identical(final, uninterrupted_2pc4)
+
+
+def test_preempt_resume_abd_fps_pipeline():
+    """The fingerprint-only expansion pipeline (ABD's default) suspends
+    and resumes bit-identically too — the payload path must cover the
+    (parent, action)-reference frontier representation."""
+    spawn_abd = {
+        "frontier_capacity": 32,
+        "table_capacity": 1 << 12,
+        "aot_cache": "t-preempt-abd",
+    }
+    reference = _abd_model().checker().spawn_tpu_bfs(**spawn_abd).join()
+    assert reference.worker_error() is None
+    assert reference.unique_state_count() == 544
+    first = _abd_model().checker().spawn_tpu_bfs(
+        max_drain_waves=2, **spawn_abd
+    )
+    assert first.pipeline == "fps"
+    if not _preempt_at(first, threshold=100):
+        pytest.skip("run finished before the preempt request landed")
+    resumed = (
+        _abd_model()
+        .checker()
+        .spawn_tpu_bfs(resume_from=first.preempt_payload(), **spawn_abd)
+        .join()
+    )
+    _assert_bit_identical(resumed, reference)
+    resumed.assert_properties()
+
+
+# -- suspend landing mid-L0→L1 eviction -------------------------------------
+
+
+def _tiny_budget(model, frontier: int, load=0.55) -> float:
+    actions = model.packed_action_count()
+    rows = 1 << math.ceil(math.log2(frontier * actions / load + 1))
+    return ((rows + 128) * 8) / (1 << 20)
+
+
+class _PreemptDuringEviction(TpuBfsChecker):
+    """Issues the preempt request from INSIDE the first L0→L1 eviction,
+    so the suspend request lands mid-eviction: the eviction must
+    complete, the yield point honors the request at the next boundary,
+    and the payload must carry the freshly-written storage tier."""
+
+    def _evict_l0(self, table):
+        self.request_preempt()
+        return super()._evict_l0(table)
+
+
+def test_preempt_mid_eviction_resume(uninterrupted_2pc4):
+    budget = _tiny_budget(TwoPhaseSys(4), 16)
+    first = _PreemptDuringEviction(
+        TwoPhaseSys(4).checker(),
+        frontier_capacity=16,
+        table_capacity=1 << 12,
+        hbm_budget_mib=budget,
+        max_drain_waves=2,
+        aot_cache="t-preempt-2pc4-oob",
+    )
+    for h in first.handles():
+        h.join()
+    assert first.worker_error() is None
+    assert first.preempted, "the post-eviction boundary must honor the request"
+    payload = first.preempt_payload()
+    assert payload.get("storage"), (
+        "a suspend landing mid-eviction must carry the L1 runs"
+    )
+    assert first.unique_state_count() < 1568
+
+    resumed = (
+        TwoPhaseSys(4)
+        .checker()
+        .spawn_tpu_bfs(
+            frontier_capacity=16,
+            table_capacity=1 << 12,
+            hbm_budget_mib=budget,
+            resume_from=payload,
+            aot_cache="t-preempt-2pc4-oob",
+        )
+        .join()
+    )
+    _assert_bit_identical(resumed, uninterrupted_2pc4)
+    assert resumed.unique_state_count() == 1568
+    resumed.assert_properties()
+
+
+# -- sharded checker yield points -------------------------------------------
+
+
+def _sharded(model_checker, **kw):
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.array(jax.devices()[:8]), ("fp",))
+    kw.setdefault("frontier_per_device", 32)
+    kw.setdefault("table_capacity_per_device", 512)
+    return model_checker.spawn_sharded_tpu_bfs(mesh=mesh, **kw)
+
+
+def test_preempt_resume_sharded():
+    reference = _sharded(TwoPhaseSys(4).checker()).join()
+    assert reference.worker_error() is None
+    assert reference.unique_state_count() == 1568
+    first = _sharded(
+        TwoPhaseSys(4).checker(), max_drain_waves=2,
+    )
+    if not _preempt_at(first, threshold=200):
+        pytest.skip("run finished before the preempt request landed")
+    resumed = _sharded(
+        TwoPhaseSys(4).checker(),
+        resume_from=first.preempt_payload(),
+    ).join()
+    assert resumed.worker_error() is None
+    assert resumed.unique_state_count() == reference.unique_state_count()
+    assert resumed.state_count() == reference.state_count()
+    assert resumed._discoveries_fp == reference._discoveries_fp
+    resumed.assert_properties()
