@@ -313,6 +313,14 @@ def _run_leg(leg: str, pin_cpu: bool):
     if "--attribution" in sys.argv:
         spec["spawn"]["attribution"] = True
         out["attribution_enabled"] = True
+    # Async pipelined wave engine (--async-pipeline): wave N's host-tier
+    # probe/evict/checkpoint overlap wave N+1's device dispatch on a
+    # host worker thread. Results bit-identical; the per-leg attribution
+    # record gains the overlapped ledger. See bench.py --async-ab for
+    # the dedicated on/off comparison leg.
+    if "--async-pipeline" in sys.argv:
+        spec["spawn"]["async_pipeline"] = True
+        out["async_pipeline"] = True
     # State-space cartography (--coverage): the in-wave coverage
     # reductions (telemetry/coverage.py) ride the run; the per-leg
     # record carries the full report (actions/properties/shape/vacuity).
@@ -723,7 +731,10 @@ def _budget_override_args():
         if value is not None:
             args += [flag, str(value)]
     # Boolean flags forwarded verbatim (same silently-no-op hazard).
-    for flag in ("--attribution", "--coverage", "--no-calibrate"):
+    for flag in (
+        "--attribution", "--coverage", "--no-calibrate",
+        "--async-pipeline",
+    ):
         if flag in sys.argv:
             args.append(flag)
     return tuple(args)
@@ -1002,6 +1013,196 @@ def _run_service_leg(pin_cpu: bool):
     print(json.dumps(out))
 
 
+ASYNC_AB_TIMEOUT_S = 1800
+
+
+def _run_async_ab_leg(pin_cpu: bool):
+    """Child entry: the async-pipeline A/B (BENCH_r11+, ROADMAP item 3's
+    acceptance gate). One out-of-core 2pc-N run twice with the SAME
+    spawn config — async_pipeline off, then on — both with attribution
+    ledgers. Asserts bit-identical results (counts, depths,
+    discoveries, golden reporter) and records per-leg rate, realized
+    pipeline utilization, the async-off ledger's PREDICTED utilization
+    under perfect overlap (the PR-7 headroom estimate), and the
+    async-on worker's achieved overlap — the instrument closing its
+    own loop. Config mirrors tests/test_storage_equivalence.py's
+    acceptance run (frontier 16 forces multiple L0 evictions)."""
+    import io
+    import re
+
+    import jax
+
+    if pin_cpu:
+        jax.config.update("jax_platforms", "cpu")
+    from stateright_tpu.utils.compile_cache import enable_persistent_cache
+
+    enable_persistent_cache()
+    from stateright_tpu import WriteReporter
+    from stateright_tpu.models.two_phase_commit import TwoPhaseSys
+    from stateright_tpu.telemetry import metrics_registry
+
+    device = jax.devices()[0]
+    log(f"[async_ab] device: {device.platform} ({device})")
+    rm = int(_parse_float_flag("--ab-rm") or 5)
+    model = TwoPhaseSys(rm)
+    frontier = 16
+    budget = _parse_float_flag("--hbm-budget-mib")
+    if budget is None:
+        # The smallest admissible budget for this frontier: maximum
+        # eviction pressure (THE shared definition — it tracks the
+        # checker's load factor and table layout by construction).
+        from stateright_tpu.checker.tpu import (
+            min_admissible_hbm_budget_mib,
+        )
+
+        budget = min_admissible_hbm_budget_mib(model, frontier)
+    spawn = dict(
+        frontier_capacity=frontier,
+        table_capacity=1 << 14,
+        hbm_budget_mib=budget,
+        attribution=True,
+    )
+    out = {
+        "device": device.platform,
+        "model": f"2pc-{rm}",
+        "hbm_budget_mib": budget,
+        # CPU boxes make the rate half of this leg noise; the
+        # utilization delta is the claim (see tier1.yml note). Keyed
+        # "async_advisory" so bench_compare's trajectory gate reads it
+        # as the advisory flag of the "async" headline leg.
+        "async_advisory": device.platform == "cpu",
+    }
+
+    def golden(checker):
+        sink = io.StringIO()
+        checker.report(WriteReporter(sink))
+        return re.sub(r"sec=\d+", "sec=_", sink.getvalue())
+
+    legs = {}
+    goldens = {}
+    for name, async_on in (("async_off", False), ("async_on", True)):
+        metrics_registry().reset()
+        t0 = time.time()
+        checker = (
+            TwoPhaseSys(rm)
+            .checker()
+            .spawn_tpu_bfs(**spawn, async_pipeline=async_on)
+            .join()
+        )
+        wall = time.time() - t0
+        warm = checker.warmup_seconds or 0.0
+        rep = checker.attribution_report()
+        snap = checker.metrics().snapshot()
+        leg = {
+            "unique": checker.unique_state_count(),
+            "states": checker.state_count(),
+            "max_depth": checker.max_depth(),
+            "wall_s": wall,
+            "warmup_s": warm,
+            "rate": checker.unique_state_count() / max(wall - warm, 1e-9),
+            "utilization": rep.get("utilization"),
+            "monitor_utilization_gauge": snap.get(
+                "tpu_bfs.pipeline.utilization"
+            ),
+            "evictions": snap.get("tpu_bfs.storage.evictions"),
+            "attribution": rep,
+        }
+        if async_on:
+            leg["overlapped_total_s"] = rep.get("overlapped_total_s")
+        if not leg["evictions"]:
+            # The leg's whole claim is out-of-core overlap; a budget
+            # that never bound (e.g. the load-factor arithmetic above
+            # drifting from checker/tpu._MAX_LOAD) would silently
+            # compare two in-core runs and report a ~0 delta as if the
+            # acceptance gate ran.
+            raise AssertionError(
+                f"async A/B {name} leg recorded no L0 evictions — the "
+                f"hbm budget ({budget} MiB) never bound; the leg is "
+                "not measuring the out-of-core pipeline"
+            )
+        legs[name] = leg
+        goldens[name] = golden(checker)
+        log(
+            f"[async_ab] {name}: {leg['unique']} unique, "
+            f"{leg['rate']:,.0f}/s, utilization="
+            f"{(leg['utilization'] or 0.0):.3f}"
+        )
+    identical = (
+        legs["async_off"]["unique"] == legs["async_on"]["unique"]
+        and legs["async_off"]["states"] == legs["async_on"]["states"]
+        and legs["async_off"]["max_depth"] == legs["async_on"]["max_depth"]
+        and goldens["async_off"] == goldens["async_on"]
+    )
+    out["bit_identical"] = identical
+    if not identical:
+        raise AssertionError(
+            "async-on leg diverged from async-off: "
+            f"{ {k: (v['unique'], v['states'], v['max_depth']) for k, v in legs.items()} }"
+        )
+    off_att = legs["async_off"]["attribution"]
+    oh = off_att.get("overlap_headroom") or {}
+    device_s = (off_att.get("phases_s") or {}).get("device")
+    predicted_wall = oh.get("predicted_wall_s")
+    out["predicted_utilization"] = (
+        device_s / predicted_wall
+        if device_s is not None and predicted_wall
+        else None
+    )
+    out["utilization_delta"] = (
+        (legs["async_on"]["utilization"] or 0.0)
+        - (legs["async_off"]["utilization"] or 0.0)
+    )
+    out["async_off"] = legs["async_off"]
+    out["async_on"] = legs["async_on"]
+    print(json.dumps(out))
+
+
+def _main_async_ab():
+    """Parent entry for ``bench.py --async-ab``: runs the A/B leg in a
+    child (wedge isolation) and prints the one BENCH-record JSON line
+    (render it with ``scripts/bench_compare.py --ab-async``)."""
+    on_accel = _accelerator_usable()
+    passthrough = []
+    for flag in ("--ab-rm", "--hbm-budget-mib"):
+        value = _parse_float_flag(flag)
+        if value is not None:
+            passthrough += [flag, str(value)]
+
+    def run(pin_cpu):
+        argv = [sys.executable, __file__, "--async-ab-leg", *passthrough]
+        if pin_cpu:
+            argv.append("--cpu")
+        return _child_json(
+            argv, ASYNC_AB_TIMEOUT_S * (3 if pin_cpu else 1), "async_ab"
+        )
+
+    rec = run(pin_cpu=not on_accel)
+    if rec is None and on_accel:
+        log("[async_ab] falling back to CPU-pinned run")
+        rec = run(pin_cpu=True)
+    if rec is None:
+        print(
+            json.dumps(
+                {
+                    "metric": "async pipeline A/B "
+                    "(out-of-core 2pc, async on vs off)",
+                    "value": 0,
+                    "unit": "unique states/sec",
+                    "error": "async A/B leg failed on every backend",
+                }
+            )
+        )
+        return
+    line = {
+        "metric": "async pipeline A/B "
+        f"(out-of-core {rec['model']}, async on vs off)",
+        "value": round(rec["async_on"]["rate"], 1),
+        "unit": "unique states/sec",
+        **rec,
+    }
+    print(json.dumps(line))
+
+
 def _main_service():
     """Parent entry for ``bench.py --service``: runs the service leg in
     a child (wedge isolation, like every other leg) and prints the one
@@ -1054,6 +1255,10 @@ def main():
         return _run_service_leg("--cpu" in sys.argv)
     if "--service" in sys.argv:
         return _main_service()
+    if "--async-ab-leg" in sys.argv:
+        return _run_async_ab_leg("--cpu" in sys.argv)
+    if "--async-ab" in sys.argv:
+        return _main_async_ab()
     if "--breakdown" in sys.argv:
         return _run_breakdown(
             sys.argv[sys.argv.index("--breakdown") + 1], "--cpu" in sys.argv

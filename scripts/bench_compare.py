@@ -217,6 +217,86 @@ def _fmt(value):
     return f"{value:,.1f}" if value is not None else "-"
 
 
+def _leg_utilization(leg):
+    """(realized, predicted-under-full-overlap) from one A/B leg's
+    attribution record; (None, None) when the ledger is absent."""
+    att = leg.get("attribution") or {}
+    realized = att.get("utilization")
+    oh = att.get("overlap_headroom") or {}
+    device = (att.get("phases_s") or {}).get("device")
+    predicted_wall = oh.get("predicted_wall_s")
+    predicted = (
+        device / predicted_wall
+        if device is not None and predicted_wall
+        else None
+    )
+    return realized, predicted
+
+
+def ab_async_report(path, out=sys.stdout):
+    """The async-pipeline A/B table from one ``bench.py --async-ab``
+    record (BENCH_r11+): rate and pipeline-utilization deltas between
+    the async-off and async-on legs, with the async-off ledger's
+    PREDICTED utilization (the PR-7 headroom estimate) next to the
+    async-on leg's REALIZED one — the instrument closing its own loop.
+    Always advisory (exit 0 when both legs parsed): CPU boxes make
+    rate claims noise; the bit-identical assert lives in the bench
+    child itself."""
+    with open(path) as f:
+        obj = json.load(f)
+    rec = obj.get("parsed") if isinstance(obj, dict) and "parsed" in obj \
+        else obj
+    if not isinstance(rec, dict):
+        print(f"error: {path}: no parsed A/B record", file=sys.stderr)
+        return 2
+    off, on = rec.get("async_off"), rec.get("async_on")
+    if not off or not on:
+        print(
+            f"error: {path}: record carries no async_off/async_on legs "
+            "(produce one with bench.py --async-ab)",
+            file=sys.stderr,
+        )
+        return 2
+    u_off, predicted = _leg_utilization(off)
+    u_on, _ = _leg_utilization(on)
+    header = (
+        f"{'':<14} {'async off':>12} {'async on':>12} {'delta':>8}"
+    )
+    out.write(header + "\n" + "-" * len(header) + "\n")
+    r_off, r_on = off.get("rate"), on.get("rate")
+    rate_delta = (
+        f"{(r_on - r_off) / r_off:+.1%}" if r_off and r_on else ""
+    )
+    out.write(
+        f"{'states/s':<14} {_fmt(r_off):>12} {_fmt(r_on):>12} "
+        f"{rate_delta:>8}\n"
+    )
+    def pct(v):
+        return f"{100.0 * v:.1f}%" if v is not None else "-"
+    util_delta = (
+        f"{100.0 * (u_on - u_off):+.1f}pp"
+        if u_on is not None and u_off is not None
+        else ""
+    )
+    out.write(
+        f"{'utilization':<14} {pct(u_off):>12} {pct(u_on):>12} "
+        f"{util_delta:>8}\n"
+    )
+    out.write(
+        f"{'predicted':<14} {pct(predicted):>12} {'(realized ^)':>12}\n"
+    )
+    overlapped = on.get("overlapped_total_s")
+    if overlapped is not None:
+        out.write(
+            f"achieved overlap: {overlapped:.2f}s host work run on the "
+            "pipeline worker (upper bound on wall saved; the realized "
+            "saving is the rate/utilization delta above)\n"
+        )
+    if rec.get("bit_identical") is not None:
+        out.write(f"bit-identical: {rec['bit_identical']}\n")
+    return 0
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(
         description="Per-leg rate deltas between bench trajectory files, "
@@ -239,7 +319,25 @@ def main(argv=None):
         help="leg name for bare single-leg result files (bench.py --leg "
         "output); default: the file stem",
     )
+    parser.add_argument(
+        "--ab-async", action="store_true",
+        help="render the async-pipeline A/B table (rate + predicted vs "
+        "realized utilization) from one bench.py --async-ab record",
+    )
     args = parser.parse_args(argv)
+
+    if args.ab_async:
+        if len(args.files) != 1:
+            print(
+                "error: --ab-async takes exactly one bench record",
+                file=sys.stderr,
+            )
+            return 2
+        try:
+            return ab_async_report(args.files[0])
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"error: {args.files[0]}: {e}", file=sys.stderr)
+            return 2
 
     loaded = []
     for path in args.files:

@@ -17,6 +17,16 @@ pipelined wave engine (ROADMAP item 2):
     headroom  = min(host_probe + evict + checkpoint, device)
     predicted = wall - headroom
 
+When the trace came from an ``async_pipeline=True`` run, the worker's
+``<prefix>.pipeline.overlapped`` spans carry the host time actually
+shadowed under device compute; the report then renders the ACHIEVED
+overlap next to the prediction — realized utilization (device/wall)
+against the utilization a perfect overlap of this ledger's own host
+phases would produce — closing the loop the PR-7 headroom estimate
+opened. Compare an async-off and an async-on leg of the same model with
+``scripts/bench_compare.py --ab-async`` for the per-leg
+predicted-vs-realized delta.
+
 ``--json`` emits the ledgers as one JSON object instead of the tables
 (machine-readable; the tests consume it). The event loader, the
 ``.pipeline``-span aggregation, and the phase lists are shared with
@@ -56,6 +66,25 @@ def collect_ledgers(events):
     return ledgers
 
 
+def collect_overlapped(events):
+    """Per-prefix ACHIEVED-overlap sums from the async worker's
+    ``<prefix>.pipeline.overlapped`` spans: ``{prefix: {phase: ms}}``.
+    Empty for synchronous (async-off) traces."""
+    out = {}
+    for ev in events:
+        if ev.get("ph") != "X":
+            continue
+        name = ev.get("name", "")
+        if not name.endswith(".pipeline.overlapped"):
+            continue
+        prefix = name[: -len(".pipeline.overlapped")]
+        args = ev.get("args") or {}
+        phase = args.get("phase") or "overlapped"
+        d = out.setdefault(prefix, {})
+        d[phase] = d.get(phase, 0.0) + float(ev.get("dur", 0.0)) / 1e3
+    return out
+
+
 def overlap_headroom(led):
     """The headroom block for one ledger: always non-null (zero host
     phases => zero headroom, predicted == measured)."""
@@ -73,13 +102,36 @@ def overlap_headroom(led):
     }
 
 
+def utilization_block(led, overlapped_ms=None):
+    """Predicted vs realized utilization for one ledger. ``realized`` is
+    what the run measured (device share of wall); ``predicted`` is the
+    utilization a perfect overlap of this ledger's own host phases
+    would produce. On an async-on trace, ``achieved_overlap_ms`` is the
+    host time executed on the pipeline worker — an upper bound on the
+    wall actually saved (fractions spent while the checker was blocked
+    at an epoch barrier ran against an idle device)."""
+    wall = led["wall_ms"]
+    oh = overlap_headroom(led)
+    predicted_wall = oh["predicted_wall_ms"]
+    block = {
+        "realized": (oh["device_ms"] / wall) if wall else None,
+        "predicted_under_full_overlap": (
+            oh["device_ms"] / predicted_wall if predicted_wall else None
+        ),
+    }
+    if overlapped_ms:
+        block["achieved_overlap_ms"] = dict(sorted(overlapped_ms.items()))
+        block["achieved_overlap_total_ms"] = sum(overlapped_ms.values())
+    return block
+
+
 def _phase_rows(phases_ms):
     known = [p for p in PHASE_ORDER if p in phases_ms]
     extra = sorted(p for p in phases_ms if p not in PHASE_ORDER)
     return known + extra
 
 
-def print_ledger(prefix, led, out=sys.stdout):
+def print_ledger(prefix, led, overlapped_ms=None, out=sys.stdout):
     wall = led["wall_ms"]
     out.write(
         f"phase ledger: {prefix} ({led['waves']} waves, "
@@ -98,8 +150,29 @@ def print_ledger(prefix, led, out=sys.stdout):
         "  (* host phases an async pipelined engine could overlap)\n"
         f"overlap headroom: {oh['headroom_ms']:.1f} ms "
         f"({oh['headroom_pct']:.1f}% of wall) — predicted wall under "
-        f"perfect host/device overlap: {oh['predicted_wall_ms']:.1f} ms\n\n"
+        f"perfect host/device overlap: {oh['predicted_wall_ms']:.1f} ms\n"
     )
+    util = utilization_block(led, overlapped_ms)
+    realized = util["realized"]
+    predicted = util["predicted_under_full_overlap"]
+    out.write(
+        f"utilization: realized {100.0 * (realized or 0.0):.1f}% vs "
+        f"{100.0 * (predicted or 0.0):.1f}% predicted under full "
+        "overlap"
+    )
+    if overlapped_ms:
+        per_phase = " ".join(
+            f"{p}={ms:.1f}ms"
+            for p, ms in sorted(overlapped_ms.items())
+        )
+        out.write(
+            f"\nachieved overlap (async pipeline): "
+            f"{util['achieved_overlap_total_ms']:.1f} ms host work run "
+            f"on the pipeline worker ({per_phase}) — an upper bound on "
+            "wall saved (barrier-stalled fractions included); the "
+            "realized saving is the utilization/wall delta"
+        )
+    out.write("\n\n")
 
 
 def main(argv=None):
@@ -116,6 +189,7 @@ def main(argv=None):
 
     events = load_events(args.trace)
     ledgers = collect_ledgers(events)
+    overlapped = collect_overlapped(events)
     if not ledgers:
         print(
             f"no .pipeline attribution spans in {args.trace} — was the "
@@ -125,14 +199,25 @@ def main(argv=None):
         return 1
     if args.json:
         out = {
-            prefix: {**led, "overlap_headroom": overlap_headroom(led)}
+            prefix: {
+                **led,
+                "overlap_headroom": overlap_headroom(led),
+                "utilization": utilization_block(
+                    led, overlapped.get(prefix)
+                ),
+                **(
+                    {"overlapped_ms": overlapped[prefix]}
+                    if prefix in overlapped
+                    else {}
+                ),
+            }
             for prefix, led in sorted(ledgers.items())
         }
         json.dump(out, sys.stdout, indent=2)
         sys.stdout.write("\n")
         return 0
     for prefix, led in sorted(ledgers.items()):
-        print_ledger(prefix, led)
+        print_ledger(prefix, led, overlapped.get(prefix))
     return 0
 
 

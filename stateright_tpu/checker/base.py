@@ -157,6 +157,44 @@ class Checker(Generic[State, Action]):
             return _NULL_CTX
         return self._attr.wave(kind)
 
+    def _phase_overlapped(self, name: str):
+        """An attribution window for host-tier work running on the async
+        pipeline's worker thread, shadowed under device compute: records
+        into the thread-safe ``overlapped`` ledger instead of the wave
+        window (``telemetry/attribution.py``). No-op when attribution is
+        off."""
+        if self._attr is None:
+            return _NULL_CTX
+        return self._attr.overlapped(name)
+
+    # -- async pipeline plumbing (device checkers set _pipe; see
+    # checker/pipeline.py) --------------------------------------------------
+
+    _pipe = None
+
+    def _shutdown_pipeline(self) -> None:
+        """Run-end epoch barrier + worker teardown: a verdict error that
+        nothing drained yet becomes the worker error, and the host
+        thread never outlives the run."""
+        if self._pipe is None:
+            return
+        try:
+            self._pipe.drain()
+        except BaseException as e:  # noqa: BLE001 - surfaced via worker_error
+            if self._error is None:
+                self._error = e
+        finally:
+            self._pipe.close()
+
+    def _checkpoint_write(self, path, payload) -> None:
+        """Pipeline-worker half of a deferred checkpoint (the payload
+        was snapshotted at the epoch barrier; only the pickle + atomic
+        rename ride the worker)."""
+        from .tpu import atomic_pickle
+
+        with self._phase_overlapped("checkpoint"):
+            atomic_pickle(path, payload)
+
     def _abort_attribution(self) -> None:
         """Worker-error-path cleanup: closes any window the crash left
         open so the dying wave's ``.pipeline`` span still reaches the

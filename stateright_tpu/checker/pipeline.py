@@ -1,0 +1,148 @@
+"""The async wave engine's host-side lane: one FIFO worker thread.
+
+In ``async_pipeline=True`` mode the device checkers dispatch wave N+1
+while wave N's host-tier work — the two-phase Bloom+run probe at the
+wave exit, L0→L1 eviction absorbs (and the LSM merges/spills they
+trigger), and checkpoint serialization — runs here. The design is a
+two-deep pipeline (ScalaBFS-style channel pipelining, PAPERS.md): the
+device owns expansion/fingerprint/insert, this thread owns the tiered
+store's verdicts, and survivors of a deferred probe re-enter the
+frontier one wave late through the shared chunk queue.
+
+Correctness rests on three properties this class enforces:
+
+- **FIFO**: jobs run in submission order on ONE thread, so the tiered
+  store sees the exact sequence of probes and evictions the synchronous
+  path would issue (a probe submitted before an eviction can never
+  observe the evicted keys — the "merge fence").
+- **Epoch barriers**: ``drain()`` blocks until every submitted job
+  finished, re-raising the first job error. Checkers call it at
+  checkpoint, preempt, queue-empty, and run-end boundaries, so every
+  externally observable snapshot (payloads, counters read after
+  ``join()``) is identical to the synchronous path's.
+- **Bounded depth**: ``throttle()`` caps the verdict backlog (the
+  "pending-verdict lane set"), so at most ``max_pending`` waves of
+  device output buffers are pinned at once.
+
+A job that raises poisons the pipeline: later jobs are skipped (their
+inputs may depend on the failed verdict) and the error surfaces at the
+next ``submit``/``throttle``/``drain`` on the checker thread, which
+routes it into ``worker_error()`` like any other worker failure.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Callable, Optional
+
+__all__ = ["HostPipeline"]
+
+# Default pending-verdict depth: the producing wave plus one in-flight
+# verdict — the "two-deep" in the two-deep pipeline. Deeper queues pin
+# more wave-output buffers without adding overlap (the device is already
+# never idle at depth 2).
+DEFAULT_MAX_PENDING = 2
+
+
+class HostPipeline:
+    """One daemon worker thread executing host-tier jobs in FIFO order."""
+
+    def __init__(self, name: str = "host-pipeline",
+                 max_pending: int = DEFAULT_MAX_PENDING):
+        self.max_pending = max(1, max_pending)
+        self._cv = threading.Condition()
+        self._jobs: deque = deque()
+        self._pending = 0
+        self._submitted = 0
+        self._error: Optional[BaseException] = None
+        self._closed = False
+        self._thread = threading.Thread(
+            target=self._loop, name=name, daemon=True
+        )
+        self._thread.start()
+
+    # -- checker-thread surface --------------------------------------------
+
+    def submit(self, fn: Callable[[], None]) -> None:
+        """Enqueues one job. Raises the pipeline's poisoning error, if
+        any — the checker must not keep producing waves whose verdicts
+        can never be applied."""
+        with self._cv:
+            self._raise_if_poisoned()
+            if self._closed:
+                raise RuntimeError("host pipeline is closed")
+            self._jobs.append(fn)
+            self._pending += 1
+            self._submitted += 1
+            self._cv.notify_all()
+
+    def throttle(self, max_pending: Optional[int] = None) -> None:
+        """Blocks until the backlog is within the pipeline depth (the
+        bounded pending-verdict lane set)."""
+        limit = self.max_pending if max_pending is None else max_pending
+        with self._cv:
+            self._cv.wait_for(
+                lambda: self._pending <= limit or self._error is not None
+            )
+            self._raise_if_poisoned()
+
+    def drain(self) -> None:
+        """Epoch barrier: returns once every submitted job has finished;
+        re-raises the first job error on this (the caller's) thread."""
+        with self._cv:
+            self._cv.wait_for(
+                lambda: self._pending == 0 or self._error is not None
+            )
+            # Poisoned: skipped jobs still drain to zero, but the state
+            # they would have produced does not exist — surface it.
+            self._raise_if_poisoned()
+
+    def pending(self) -> int:
+        with self._cv:
+            return self._pending
+
+    @property
+    def submitted(self) -> int:
+        """Total jobs ever submitted (telemetry/tests)."""
+        with self._cv:
+            return self._submitted
+
+    def close(self) -> None:
+        """Stops the worker after the queue empties. Never raises —
+        called from run-end/error paths; surface job errors via
+        ``drain()`` first when they matter."""
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+        self._thread.join(timeout=60.0)
+
+    def _raise_if_poisoned(self) -> None:
+        if self._error is not None:
+            raise RuntimeError(
+                "async host pipeline failed; no further host-tier work "
+                "can be applied"
+            ) from self._error
+
+    # -- worker thread ------------------------------------------------------
+
+    def _loop(self) -> None:
+        while True:
+            with self._cv:
+                while not self._jobs and not self._closed:
+                    self._cv.wait()
+                if not self._jobs:
+                    return  # closed and drained
+                fn = self._jobs.popleft()
+                poisoned = self._error is not None
+            try:
+                if not poisoned:
+                    fn()
+            except BaseException as e:  # noqa: BLE001 - surfaced at barriers
+                with self._cv:
+                    if self._error is None:
+                        self._error = e
+            finally:
+                with self._cv:
+                    self._pending -= 1
+                    self._cv.notify_all()

@@ -69,6 +69,7 @@ from ..telemetry import (
     metrics_registry,
 )
 from .base import _NULL_CTX, Checker  # noqa: F401 - _NULL_CTX re-exported
+from .pipeline import HostPipeline
 
 _DEPTH_INF = (1 << 31) - 1
 _U32_MAX = np.uint32(0xFFFFFFFF)  # numpy: keeps module import backend-free
@@ -435,6 +436,28 @@ def _pow2ceil(n: int) -> int:
     return 1 << max(0, (n - 1).bit_length())
 
 
+def min_admissible_hbm_budget_mib(model, frontier_capacity: int) -> float:
+    """The smallest ``hbm_budget_mib`` a checker with this model and
+    frontier width accepts — i.e. MAXIMUM eviction pressure. THE shared
+    definition (the inverse of ``storage.max_table_rows_for_budget``,
+    priced with the same 8-byte row + probe apron): one worst-case wave
+    (frontier × action_count candidates) must fit a freshly-evicted
+    table under ``_MAX_LOAD``. bench.py's --async-ab leg and the
+    equivalence tests both use it, so a load-factor or layout change
+    cannot silently stop their budgets from binding."""
+    from ..ops.hashset import MAX_PROBES
+
+    rows = _pow2ceil(
+        int(
+            _pow2ceil(frontier_capacity)
+            * model.packed_action_count()
+            / _MAX_LOAD
+        )
+        + 1
+    )
+    return ((rows + MAX_PROBES) * 8) / (1 << 20)
+
+
 # -- cross-checker AOT executable sharing (checking-as-a-service) -----------
 #
 # One resident process serving many jobs must never recompile a wave shape
@@ -482,6 +505,16 @@ class TpuBfsChecker(Checker):
     host-resident delta-compressed runs (L1), and ``host_budget_mib`` /
     ``spill_dir`` spill merged runs to disk (L2). Results are
     bit-identical to the unbounded run; see README "Memory hierarchy".
+
+    ``async_pipeline=True`` turns the wave loop into a two-deep
+    pipeline: wave N+1's expand/fingerprint/insert runs on device while
+    a host worker thread applies wave N's tiered-store probe, eviction
+    absorbs, and checkpoint serialization; survivors of the deferred
+    probe re-enter the frontier one wave late at the queue tail —
+    exactly where the synchronous path would have appended them — so
+    results stay bit-identical (README "Async pipeline"). Requires no
+    visitor (per-chunk callbacks need each wave's verdict before the
+    next dispatch).
     """
 
     def __init__(
@@ -508,6 +541,7 @@ class TpuBfsChecker(Checker):
         coverage=False,
         run_id=None,
         aot_cache=None,
+        async_pipeline=False,
     ):
         model = options.model
         if not isinstance(model, BatchableModel):
@@ -726,6 +760,26 @@ class TpuBfsChecker(Checker):
         # file and the worker exits (see request_preempt).
         self._preempt_event = threading.Event()
         self._preempt_payload: Optional[dict] = None
+        # Async pipelined wave engine (README "Async pipeline"): one FIFO
+        # host worker (checker/pipeline.py) applies each wave's host-tier
+        # verdict — two-phase probe, parent-fp log, survivor re-entry —
+        # plus eviction absorbs and checkpoint pickles, while the device
+        # runs the next wave. FIFO submission order reproduces the
+        # synchronous path's exact tier-operation sequence, and epoch
+        # barriers (drain) at checkpoint/preempt/queue-empty boundaries
+        # make every observable snapshot identical.
+        self._async = bool(async_pipeline)
+        if self._async and self._visitor is not None:
+            raise ValueError(
+                "async_pipeline is incompatible with a visitor: per-chunk "
+                "callbacks reconstruct paths through verdicts the "
+                "pipeline defers; drop the visitor or run synchronously"
+            )
+        self._pipe = (
+            HostPipeline(name="tpu-bfs-host") if self._async else None
+        )
+        if self._attr is not None and self._async:
+            self._attr.set_overlap_mode(True)
 
         # Fingerprints go through the model's view hook (e.g. actor systems
         # exclude crash flags, mirroring the host state hash).
@@ -1448,15 +1502,24 @@ class TpuBfsChecker(Checker):
             self._error = e
             self._abort_attribution()
         finally:
+            # The pipeline must be quiescent before done is observable:
+            # counters/logs a late verdict would mutate are read the
+            # moment join() returns.
+            self._shutdown_pipeline()
             self._finalize_coverage(set(self._discoveries_fp))
             self._done_event.set()
 
-    def _grow_table(self, table, min_capacity):
+    def _grow_table(self, table, min_capacity, defer_evict=False):
+        """Grows (or, under an HBM budget, evicts) the device table.
+        ``defer_evict=True`` — async wave loop only — hands the tier
+        absorb to the pipeline worker; deep-drain and restore callers
+        keep it synchronous because they branch on ``tier.is_empty()``
+        immediately afterwards (the out-of-core handoff)."""
         if (
             self._max_capacity is not None
             and min_capacity > self._max_capacity
         ):
-            return self._evict_l0(table)
+            return self._evict_l0(table, defer=defer_evict)
         capacity = self._capacity
         while capacity < min_capacity:
             capacity *= 2
@@ -1481,30 +1544,46 @@ class TpuBfsChecker(Checker):
                 self._max_capacity is not None
                 and capacity > self._max_capacity
             ):
-                return self._evict_l0(table)
+                return self._evict_l0(table, defer=defer_evict)
         self._capacity = capacity
         self._wi.table_grows.inc()
         self._wi.capacity.set(capacity)
         return new_table
 
-    def _evict_l0(self, table):
+    def _evict_l0(self, table, defer=False):
         """Budget-capped growth: drains the FULL device table to a host
         L1 run (delta-compressed, Bloom-fronted) and resets it — the
         out-of-core alternative to doubling. Capacity settles at the
         budget cap; the emptied table carries the hot working set from
-        here on while older fingerprints answer through the host probe."""
+        here on while older fingerprints answer through the host probe.
+
+        ``defer=True`` (async wave loop): the device-serial half — table
+        pull + reset — stays here, but the host absorb (run build, LSM
+        merges, spills) rides the pipeline worker. FIFO keeps it ordered
+        exactly as the synchronous path would: after every
+        already-submitted wave verdict (whose fresh keys this eviction
+        now holds) and before every later one (whose probes must see
+        these keys)."""
         with self._phase("evict"):
             tab = np.asarray(table)
             live = (tab[:, 0] != 0) | (tab[:, 1] != 0)
             keys = (
                 tab[live, 0].astype(np.uint64) << np.uint64(32)
             ) | tab[live, 1].astype(np.uint64)
-            self._tier.evict(keys)
+            if defer and self._pipe is not None:
+                self._pipe.submit(lambda: self._evict_absorb(keys))
+            else:
+                self._tier.evict(keys)
             self._capacity = self._max_capacity
             self._l0_count = 0
             self._wi.capacity.set(self._capacity)
             self._tier.instruments.set_l0(0)
             return hashset_new(self._capacity)
+
+    def _evict_absorb(self, keys):
+        """Pipeline-worker half of a deferred eviction."""
+        with self._phase_overlapped("evict"):
+            self._tier.evict(keys)
 
     def _set_warmup(self, seconds: float) -> None:
         """First-result warmup stamp, mirrored into telemetry so traces
@@ -1692,7 +1771,6 @@ class TpuBfsChecker(Checker):
         ``pending`` (deep-drain path) is the ring's residual count, so the
         span's ``live_lanes`` = pending + this wave's spill — the exact
         live frontier at the drain boundary."""
-        props = self._properties
         attempt = 0
         generated = 0
         wave_new = 0
@@ -1719,51 +1797,10 @@ class TpuBfsChecker(Checker):
                     max_depth=int(stats[3]),
                 )
             if attempt == 0:
-                generated = int(stats[0])
-                self._state_count += generated
-                self._max_depth = max(self._max_depth, int(stats[3]))
-                if props and stats[4]:
-                    hit = np.asarray(wave["prop_hit"])
-                    phi = np.asarray(wave["prop_hi"])
-                    plo = np.asarray(wave["prop_lo"])
-                    for i, p in enumerate(props):
-                        if hit[i] and p.name not in self._discoveries_fp:
-                            self._discoveries_fp[p.name] = fp_to_int(
-                                phi[i], plo[i]
-                            )
-                if self._visitor is not None:
-                    self._visit_chunk(chunk)
+                generated = self._apply_wave_stats(wave, stats, chunk)
             n_new = int(stats[1])
-            # Two-phase probe (out-of-core mode): the device table only
-            # vouches for the keys it currently holds — L0-fresh lanes
-            # whose key lives in an evicted L1/L2 run are STALE and must
-            # not be re-counted, re-logged, or re-expanded. One batched
-            # host probe per wave (Bloom prefilter + block binary search)
-            # during the host exit the wave path already pays.
-            keep = None
-            k64 = None
-            survivors = n_new
-            if (
-                n_new
-                and self._tier is not None
-                and not self._tier.is_empty()
-            ):
-                with self._phase("host_probe"):
-                    if self._symmetry_enabled:
-                        k64 = fp64_pairs(
-                            wave["key_hi"][:n_new], wave["key_lo"][:n_new]
-                        )
-                    else:
-                        k64 = fp64_pairs(
-                            wave["new"]["hi"][:n_new],
-                            wave["new"]["lo"][:n_new],
-                        )
-                    stale = self._tier.probe(k64)
-                n_stale = int(stale.sum())
-                if n_stale:
-                    keep = np.flatnonzero(~stale).astype(np.int32)
-                    survivors = n_new - n_stale
-                    stale_total += n_stale
+            keep, k64, survivors, n_stale = self._probe_fresh(wave, n_new)
+            stale_total += n_stale
             self._l0_count += n_new
             wave_new += survivors
             self._unique_count += survivors
@@ -1798,13 +1835,215 @@ class TpuBfsChecker(Checker):
             attempt += 1
             wave = None
 
+    def _probe_fresh(self, wave, n_new, overlapped=False):
+        """The two-phase probe for one wave attempt's fresh prefix
+        (out-of-core mode): the device table only vouches for the keys
+        it currently holds — L0-fresh lanes whose key lives in an
+        evicted L1/L2 run are STALE and must not be re-counted,
+        re-logged, or re-expanded. One batched host probe per wave
+        (Bloom prefilter + block binary search). ONE site for the sync
+        path and the async verdict job — the key selection and stale
+        gather must never diverge between them. ``overlapped`` picks
+        the attribution ledger (worker-thread time is shadowed, not
+        serial wall). Returns ``(keep, k64, survivors, n_stale)``."""
+        keep = None
+        k64 = None
+        survivors = n_new
+        n_stale = 0
+        if (
+            n_new
+            and self._tier is not None
+            and not self._tier.is_empty()
+        ):
+            phase = self._phase_overlapped if overlapped else self._phase
+            with phase("host_probe"):
+                if self._symmetry_enabled:
+                    k64 = fp64_pairs(
+                        wave["key_hi"][:n_new], wave["key_lo"][:n_new]
+                    )
+                else:
+                    k64 = fp64_pairs(
+                        wave["new"]["hi"][:n_new],
+                        wave["new"]["lo"][:n_new],
+                    )
+                stale = self._tier.probe(k64)
+            n_stale = int(stale.sum())
+            if n_stale:
+                keep = np.flatnonzero(~stale).astype(np.int32)
+                survivors = n_new - n_stale
+        return keep, k64, survivors, n_stale
+
+    def _apply_wave_stats(self, wave, stats, chunk=None):
+        """First-attempt device bookkeeping shared by the sync and async
+        consume paths (a growth retry re-expands the same frontier, so
+        this runs once per wave): generated/depth counters, discovery
+        fingerprints, and the visitor callback. ONE site on purpose —
+        the bit-identical guarantee depends on both paths applying the
+        same stats the same way. Returns the wave's generated count."""
+        generated = int(stats[0])
+        self._state_count += generated
+        self._max_depth = max(self._max_depth, int(stats[3]))
+        props = self._properties
+        if props and stats[4]:
+            hit = np.asarray(wave["prop_hit"])
+            phi = np.asarray(wave["prop_hi"])
+            plo = np.asarray(wave["prop_lo"])
+            for i, p in enumerate(props):
+                if hit[i] and p.name not in self._discoveries_fp:
+                    self._discoveries_fp[p.name] = fp_to_int(phi[i], plo[i])
+        if chunk is not None and self._visitor is not None:
+            self._visit_chunk(chunk)
+        return generated
+
+    def _consume_wave_async(self, table, chunk, queue, depth_cap, wave_no):
+        """Device half of one wave (async pipeline mode), on the checker
+        thread: dispatch, stats pull, counters/discoveries, and the
+        growth/eviction retry loop — everything the NEXT dispatch
+        decision depends on. The host-tier verdict of each attempt is
+        submitted to the pipeline worker *before* any growth/eviction
+        that follows it, so the tier sees probes and evictions in the
+        synchronous order (an eviction holds the attempt's fresh keys —
+        probing after absorbing them would mark the whole wave stale).
+        Returns the updated table; survivors re-enter via the worker."""
+        attempt = 0
+        self._last_dispatch = None
+        # Shared across this wave's attempt verdicts (worker-thread
+        # mutation only; FIFO serializes the attempts).
+        ctx = {"wave_new": 0, "stale": 0, "generated": 0}
+        while True:
+            wave, chunk = self._call_wave(table, chunk, depth_cap)
+            table = wave["table"]
+            stats = np.asarray(wave["stats"])
+            if self._cov is not None:
+                self._cov.consume_device(
+                    np.asarray(wave["cov"]),
+                    self._cov_layout,
+                    first_attempt=(attempt == 0),
+                    max_depth=int(stats[3]),
+                )
+            if attempt == 0:
+                ctx["generated"] = self._apply_wave_stats(wave, stats, chunk)
+            n_new = int(stats[1])
+            self._l0_count += n_new
+            final = not int(stats[2])
+            # Point-in-time captures: by the time the verdict job runs,
+            # the checker thread's live fields (dispatch, warmup,
+            # l0/capacity/depth) describe a LATER wave — a deferred
+            # eviction even resets l0 to 0 — so the span must carry
+            # this wave's values, not a future's.
+            self._pipe.submit(
+                lambda w=wave, c=chunk, n=n_new, f=final,
+                d=self._last_dispatch, warm=self.warmup_seconds is not None,
+                st=(self._l0_count, self._capacity, self._max_depth):
+                    self._wave_verdict(
+                        ctx, w, c, queue, n, f, wave_no, d, warm, st
+                    )
+            )
+            if final:
+                if self._cov is not None:
+                    self._cov.emit_wave_span()
+                return table
+            if self._max_capacity is not None and attempt >= 8:
+                raise RuntimeError(
+                    "a wave's candidates overflow the budget-capped "
+                    "device table after repeated evictions; raise "
+                    "hbm_budget_mib or shrink frontier_capacity"
+                )
+            table = self._grow_table(
+                table, self._capacity * 2, defer_evict=True
+            )
+            attempt += 1
+
+    def _wave_verdict(self, ctx, wave, chunk, queue, n_new, final, wave_no,
+                      dispatch, warm, state):
+        """One wave attempt's host-tier verdict, on the pipeline worker:
+        the two-phase probe against the evicted runs, the parent-fp log,
+        and survivor re-entry at the queue tail. Reads the wave's
+        (non-donated) output buffers while the device runs the next
+        wave. The final attempt emits the ``tpu_bfs.wave`` span the
+        monitor's estimator and SSE stream consume — it is the first
+        moment the wave's true survivor count exists."""
+        def verdict():
+            # tier.is_empty() inside _probe_fresh is exact HERE: every
+            # eviction is applied on this same thread, in submission
+            # order (the merge fence).
+            keep, k64, survivors, n_stale = self._probe_fresh(
+                wave, n_new, overlapped=True
+            )
+            ctx["stale"] += n_stale
+            self._unique_count += survivors
+            ctx["wave_new"] += survivors
+            if survivors:
+                self._log_wave(wave, n_new, keep, k64)
+                self._enqueue(
+                    queue, wave, n_new,
+                    chunk["hi"].shape[0] * self._A, chunk, keep,
+                )
+
+        if not final:
+            verdict()
+            return
+        # The async wave span covers the HOST VERDICT only (the device
+        # half overlaps later waves) — flagged so trace readers don't
+        # compare its dur against sync wave walls; wave wall in async
+        # mode is the .pipeline span's wall_ms.
+        with self._tracer.span(
+            "tpu_bfs.wave", wave=wave_no, async_verdict=True
+        ) as sp:
+            verdict()
+            self._record_wave_metrics(
+                sp, chunk["hi"].shape[0], ctx["generated"],
+                ctx["wave_new"], stale=ctx["stale"], dispatch=dispatch
+                or (None, None), warm=warm, state=state,
+            )
+
+    def _save_checkpoint_maybe_async(self, queue_chunks):
+        """Checkpoint at an epoch boundary. The payload snapshot is
+        always built synchronously (it must capture exactly this
+        boundary), but in async mode the pickle + atomic rename ride the
+        pipeline worker, off the critical path. Safe because the payload
+        is immutable once built (numpy copies of the chunks, exported
+        parent arrays, immutable run-state snapshots) and FIFO runs the
+        write before any later-submitted eviction.
+
+        ``queue_chunks`` is the LIVE pending-frontier container: it is
+        snapshotted only after the epoch barrier, because in-flight
+        verdicts append survivor chunks during the drain — a pre-barrier
+        snapshot would checkpoint their keys (counters, parent log)
+        without their frontier chunks, and the resumed run would never
+        expand them."""
+        if self._pipe is None:
+            self.save_checkpoint(self._checkpoint_path, list(queue_chunks))
+            return
+        self._pipe.drain()
+        payload = self.checkpoint_payload(list(queue_chunks))
+        path = self._checkpoint_path
+        self._pipe.submit(lambda: self._checkpoint_write(path, payload))
+
     def _record_wave_metrics(self, span, frontier, generated, n_new,
-                             stale=None, pending=None):
+                             stale=None, pending=None, dispatch=None,
+                             warm=None, state=None):
         """One wave's telemetry (the shared bundle does the recording).
         Occupancy is the TABLE's (L0-resident keys over capacity) — under
         tiering the global unique count keeps growing past what the
-        device holds."""
-        bucket, live = self._last_dispatch or (None, None)
+        device holds. ``dispatch``/``warm``/``state`` (= (l0, capacity,
+        max_depth)) are point-in-time captures the async verdict job
+        passes in — by the time it runs, the checker thread's live
+        fields describe a LATER wave (a deferred eviction even resets
+        l0 to 0 mid-flight)."""
+        if dispatch is not None:
+            bucket, live = dispatch
+        else:
+            bucket, live = self._last_dispatch or (None, None)
+        steady = (
+            warm if warm is not None else self.warmup_seconds is not None
+        )
+        if state is not None:
+            l0, capacity, depth = state
+        else:
+            l0, capacity, depth = (
+                self._l0_count, self._capacity, self._max_depth
+            )
         # `live` stays the last DISPATCH's live lanes (the compaction
         # denominator pairs with it); the monitor-facing live frontier is
         # separate — at a deep-drain boundary it is the ring residue plus
@@ -1816,29 +2055,55 @@ class TpuBfsChecker(Checker):
             # this over the dispatch-width `frontier` when present.
             extra["live_lanes"] = live_lanes
         if self._tier is not None:
-            self._tier.instruments.set_l0(self._l0_count)
+            self._tier.instruments.set_l0(l0)
             extra["storage_stale"] = stale or 0
+            # total_fps is exact on the verdict worker too: tier
+            # mutations are FIFO-ordered, so at this job's position the
+            # tier state matches the synchronous path's.
             extra["storage_fps"] = self._tier.total_fps
         self._wi.record(
             span,
             frontier=frontier,
             generated=generated,
             n_new=n_new,
-            occupancy=self._l0_count / self._capacity,
-            capacity=self._capacity,
-            max_depth=self._max_depth,
-            phase="warmup" if self.warmup_seconds is None else "steady",
+            occupancy=l0 / capacity,
+            capacity=capacity,
+            max_depth=depth,
+            phase="steady" if steady else "warmup",
             bucket=bucket,
             compaction_ratio=(live / bucket if bucket else None),
             **extra,
         )
 
     def _explore_waves(self, table, queue, depth_cap, t_start):
-        """Wave-at-a-time host loop (visitor callbacks / target counts)."""
+        """Wave-at-a-time host loop (visitor callbacks / target counts /
+        out-of-core probes).
+
+        With ``async_pipeline=True`` this loop becomes the two-deep
+        pipeline: each iteration dispatches the NEXT chunk as soon as
+        the previous wave's device stats are in, while the pipeline
+        worker applies the previous wave's host-tier verdict. The
+        dispatched wave sequence is identical to the synchronous path's
+        because (a) survivors only ever re-enter at the queue TAIL —
+        exactly where the synchronous path appends them — so popping the
+        head early pops the same chunk, and (b) every dispatch-affecting
+        decision (growth/eviction from ``_l0_count``, target caps,
+        discovery exits) is made from the stats the checker thread
+        already pulled, in the same order. When the queue runs dry with
+        verdicts still in flight, the epoch barrier waits for their
+        survivors before concluding the space is exhausted."""
         props = self._properties
+        pipe = self._pipe
         chunks = 0
         last_checkpoint = time.perf_counter()
-        while queue:
+        while True:
+            if pipe is not None and not queue and pipe.pending():
+                # In-flight verdicts may refill the queue (survivors
+                # land one wave late); only an empty queue AFTER the
+                # barrier means the space is exhausted.
+                pipe.drain()
+            if not queue:
+                break
             if not props:
                 break
             if len(self._discoveries_fp) == len(props):
@@ -1853,6 +2118,10 @@ class TpuBfsChecker(Checker):
                 # the whole remaining frontier here, so the checkpoint
                 # payload machinery captures the run exactly (resume is
                 # bit-identical — same argument as checkpoint/restore).
+                # Epoch barrier first: in-flight verdicts still own part
+                # of that frontier.
+                if pipe is not None:
+                    pipe.drain()
                 self._preempt_payload = self.checkpoint_payload(list(queue))
                 self._tracer.instant(
                     "tpu_bfs.preempted", chunks=len(queue), mode="wave"
@@ -1870,9 +2139,7 @@ class TpuBfsChecker(Checker):
                     >= self._checkpoint_min_interval
                 ):
                     with self._phase("checkpoint"):
-                        self.save_checkpoint(
-                            self._checkpoint_path, list(queue)
-                        )
+                        self._save_checkpoint_maybe_async(queue)
                     last_checkpoint = time.perf_counter()
                 chunks += 1
                 chunk = queue.popleft()
@@ -1881,15 +2148,29 @@ class TpuBfsChecker(Checker):
                     table = self._grow_table(
                         table,
                         _pow2ceil(int((self._l0_count + B) / _MAX_LOAD)),
+                        defer_evict=pipe is not None,
                     )
-                with self._tracer.span(
-                    "tpu_bfs.wave", wave=chunks
-                ) as sp, device_step_annotation("tpu_bfs.wave", chunks):
-                    table, _ = self._consume_wave(
-                        table, None, chunk, queue, depth_cap, span=sp
-                    )
+                if pipe is None:
+                    with self._tracer.span(
+                        "tpu_bfs.wave", wave=chunks
+                    ) as sp, device_step_annotation("tpu_bfs.wave", chunks):
+                        table, _ = self._consume_wave(
+                            table, None, chunk, queue, depth_cap, span=sp
+                        )
+                else:
+                    # Bounded pending-verdict lane set: at most
+                    # max_pending waves of device output pinned at once.
+                    pipe.throttle()
+                    with device_step_annotation("tpu_bfs.wave", chunks):
+                        table = self._consume_wave_async(
+                            table, chunk, queue, depth_cap, chunks
+                        )
             if self.warmup_seconds is None:
                 self._set_warmup(time.perf_counter() - t_start)
+        if pipe is not None:
+            # Run-end epoch barrier: counters and the parent-fp log must
+            # be settled before the audit and the done flag.
+            pipe.drain()
         self._audit_table(table)
 
     def _explore_deep(self, table, queue, depth_cap, t_start):
@@ -1930,6 +2211,10 @@ class TpuBfsChecker(Checker):
                 # yields only between drains; bound preemption latency
                 # with max_drain_waves (the service spawns jobs with a
                 # small cap, like the checkpoint-durability clamp).
+                if self._pipe is not None:
+                    # In-flight checkpoint writes must land before the
+                    # worker dies with the run.
+                    self._pipe.drain()
                 chunks = self._export_pool_chunks(pool, head, count)
                 chunks.extend(queue)
                 self._preempt_payload = self.checkpoint_payload(chunks)
@@ -1984,9 +2269,11 @@ class TpuBfsChecker(Checker):
                     # push loop above always fully drains the host queue.
                     assert not queue
                     with self._phase("checkpoint"):
-                        self.save_checkpoint(
-                            self._checkpoint_path,
-                            self._export_pool_chunks(pool, head, count),
+                        # Async mode: only the pickle+rename is deferred
+                        # (deep drains run tier-empty, so the pipeline
+                        # carries nothing else here).
+                        self._save_checkpoint_maybe_async(
+                            self._export_pool_chunks(pool, head, count)
                         )
                     last_checkpoint = time.perf_counter()
                 drains += 1

@@ -73,6 +73,7 @@ from ..telemetry import (
 )
 from .base_mesh import default_mesh
 from ..checker.base import Checker
+from ..checker.pipeline import HostPipeline
 from ..checker.tpu import (
     _AUTO_BUCKET_MIN_F,
     _DEFAULT_BUCKET_STEPS,
@@ -145,6 +146,7 @@ class ShardedTpuBfsChecker(Checker):
         attribution=False,
         coverage=False,
         run_id=None,
+        async_pipeline=False,
     ):
         model = options.model
         if not isinstance(model, BatchableModel):
@@ -309,6 +311,38 @@ class ShardedTpuBfsChecker(Checker):
         # same API as TpuBfsChecker (see checker/base.py).
         self._preempt_event = threading.Event()
         self._preempt_payload: Optional[dict] = None
+        # Async pipelined wave engine (README "Async pipeline"; see
+        # TpuBfsChecker for the full design note). Sharded twist: the
+        # host pool COALESCES rows into chunks, so the loop may only
+        # dispatch ahead of in-flight verdicts while the pool already
+        # holds a full chunk without them — below that, the epoch
+        # barrier restores the synchronous composition (the partial
+        # overlap is exactly the wide-frontier regime where the probe
+        # is expensive). The pool therefore gains a lock: the worker
+        # appends survivors while the checker thread slices chunks.
+        self._async = bool(async_pipeline)
+        if self._async and self._visitor is not None:
+            raise ValueError(
+                "async_pipeline is incompatible with a visitor: per-chunk "
+                "callbacks reconstruct paths through verdicts the "
+                "pipeline defers; drop the visitor or run synchronously"
+            )
+        if self._async and jax.process_count() > 1:
+            raise ValueError(
+                "async_pipeline is single-controller only: deferred "
+                "verdicts issue process_allgather collectives from the "
+                "worker thread, which cannot be ordered against the "
+                "checker thread's across processes"
+            )
+        self._pipe = (
+            HostPipeline(name="sharded-bfs-host") if self._async else None
+        )
+        self._pool_lock = threading.Lock()
+        # In-flight harvest verdicts (jobs that may still append pool
+        # rows) — the coalescing barrier's predicate. Deferred
+        # checkpoint pickles and evict absorbs never grow the pool, so
+        # they must not re-serialize the loop (pool_lock-guarded).
+        self._inflight_verdicts = 0
 
         self._shard = NamedSharding(self._mesh, P("fp"))
         self._replicated = NamedSharding(self._mesh, P())
@@ -409,6 +443,8 @@ class ShardedTpuBfsChecker(Checker):
         # ``sharded_bfs`` — results stay bit-identical (fences change
         # pacing only).
         self._init_attribution("sharded_bfs", attribution)
+        if self._attr is not None and self._async:
+            self._attr.set_overlap_mode(True)
         # State-space cartography (opt-in, telemetry/coverage.py): the
         # same fused reductions as TpuBfsChecker, computed per shard
         # inside the wave/drain shard_maps and summed across the mesh at
@@ -974,6 +1010,7 @@ class ShardedTpuBfsChecker(Checker):
             self._error = e
             self._abort_attribution()
         finally:
+            self._shutdown_pipeline()
             self._finalize_coverage(set(self._discoveries_fp))
             self._done_event.set()
 
@@ -988,12 +1025,16 @@ class ShardedTpuBfsChecker(Checker):
             out_shardings=self._shard,
         )()
 
-    def _grow_table(self, table, min_cap_loc):
+    def _grow_table(self, table, min_cap_loc, defer_evict=False):
+        """Grows (or, under an HBM budget, evicts) every shard's table.
+        ``defer_evict=True`` — async wave loop only — hands the tier
+        absorbs to the pipeline worker; the restore path keeps them
+        synchronous (it probes the tiers from the checker thread)."""
         if (
             self._max_cap_loc is not None
             and min_cap_loc > self._max_cap_loc
         ):
-            return self._evict_shards(table)
+            return self._evict_shards(table, defer=defer_evict)
         while self._cap_loc < min_cap_loc:
             self._cap_loc *= 2
         while True:
@@ -1010,7 +1051,7 @@ class ShardedTpuBfsChecker(Checker):
                 self._max_cap_loc is not None
                 and self._cap_loc > self._max_cap_loc
             ):
-                return self._evict_shards(table)
+                return self._evict_shards(table, defer=defer_evict)
         return out["table"]
 
     def _audit_table(self, table):
@@ -1031,23 +1072,41 @@ class ShardedTpuBfsChecker(Checker):
     def _tier_active(self) -> bool:
         return any(not t.is_empty() for t in self._tiers)
 
-    def _evict_shards(self, table):
+    def _evict_shards(self, table, defer=False):
         """Budget-capped growth: every shard's table drains to its own
         host tier (keys stay mesh-partitioned) and the sharded set
-        resets at the budget cap."""
+        resets at the budget cap. ``defer=True`` (async wave loop): the
+        table pull + reset stay device-serial here; the per-shard
+        absorbs ride the pipeline worker in shard order, fenced FIFO
+        between the surrounding wave verdicts (see TpuBfsChecker.
+        _evict_l0)."""
         with self._phase("evict"):
             tab = self._pull(table)  # (n, cap_loc + apron, 2)
+            shard_keys = []
             for d in range(self._n):
                 sh = tab[d]
                 live = (sh[:, 0] != 0) | (sh[:, 1] != 0)
                 keys = (
                     sh[live, 0].astype(np.uint64) << np.uint64(32)
                 ) | sh[live, 1].astype(np.uint64)
-                self._tiers[d].evict(keys)
+                shard_keys.append(keys)
+            if defer and self._pipe is not None:
+                self._pipe.submit(
+                    lambda ks=shard_keys: self._evict_absorb(ks)
+                )
+            else:
+                for d, keys in enumerate(shard_keys):
+                    self._tiers[d].evict(keys)
             self._cap_loc = self._max_cap_loc
             self._l0_count = 0
             self._si.set_l0(0)
             return self._new_table()
+
+    def _evict_absorb(self, shard_keys):
+        """Pipeline-worker half of a deferred eviction (all shards)."""
+        with self._phase_overlapped("evict"):
+            for d, keys in enumerate(shard_keys):
+                self._tiers[d].evict(keys)
 
     def _probe_tiers(self, keys):
         """Union membership over every shard's store (L1 then L2 inside
@@ -1097,24 +1156,28 @@ class ShardedTpuBfsChecker(Checker):
     def _pool_append(self, rows):
         n = rows["hi"].shape[0]
         if n:
-            self._pool.append(rows)
-            self._pool_count += n
+            # Locked: in async mode the pipeline worker appends
+            # survivors while the checker thread slices chunks.
+            with self._pool_lock:
+                self._pool.append(rows)
+                self._pool_count += n
 
     def _pool_take(self, width):
         """Pops up to ``width`` rows, padding to exactly ``width``."""
         parts = []
         got = 0
-        while got < width and self._pool:
-            batch = self._pool.popleft()
-            n = batch["hi"].shape[0]
-            if got + n > width:
-                keep = width - got
-                self._pool.appendleft(self._rows_slice(batch, keep, n))
-                batch = self._rows_slice(batch, 0, keep)
-                n = keep
-            parts.append(batch)
-            got += n
-        self._pool_count -= got
+        with self._pool_lock:
+            while got < width and self._pool:
+                batch = self._pool.popleft()
+                n = batch["hi"].shape[0]
+                if got + n > width:
+                    keep = width - got
+                    self._pool.appendleft(self._rows_slice(batch, keep, n))
+                    batch = self._rows_slice(batch, 0, keep)
+                    n = keep
+                parts.append(batch)
+                got += n
+            self._pool_count -= got
 
         def cat_pad(*xs):
             out = np.concatenate(xs) if len(xs) > 1 else np.asarray(xs[0])
@@ -1186,12 +1249,37 @@ class ShardedTpuBfsChecker(Checker):
             self._explore_waves(table, depth_cap)
 
     def _explore_waves(self, table, depth_cap):
+        """Wave-at-a-time host loop. With ``async_pipeline=True`` the
+        harvest verdict (row pulls, tier probe, survivor re-pooling)
+        rides the pipeline worker while the device runs the next chunk.
+        The sharded pool COALESCES rows into chunks, so the loop only
+        runs ahead of in-flight verdicts while the pool already holds a
+        full chunk without them — the head ``G`` rows and the bucket
+        choice are then invariant to tail appends, keeping the
+        dispatched sequence bit-identical to the synchronous path's;
+        below a full chunk the epoch barrier restores the synchronous
+        composition exactly."""
         props = self._properties
         n, G, A = self._n, self._G, self._A
+        pipe = self._pipe
 
         chunks = 0
         last_checkpoint = time.perf_counter()
-        while self._pool_count:
+        while True:
+            if (
+                pipe is not None
+                and self._inflight_verdicts > 0
+                and self._pool_count < G
+            ):
+                # Coalescing barrier (see docstring): in-flight harvest
+                # verdicts may shape the next chunk — wait for them.
+                # Keyed on verdicts, not pipe.pending(): a deferred
+                # checkpoint pickle or evict absorb cannot add pool
+                # rows, and draining on those would re-serialize the
+                # exact work the deferral hides.
+                pipe.drain()
+            if not self._pool_count:
+                break
             if not props:
                 break
             if len(self._discoveries_fp) == len(props):
@@ -1204,8 +1292,11 @@ class ShardedTpuBfsChecker(Checker):
             if self._preempt_event.is_set():
                 # Wave-granular yield: the host pool IS the whole
                 # remaining frontier here (no chunk in flight between
-                # iterations), so the checkpoint payload captures the
-                # run exactly and the resume is bit-identical.
+                # iterations) once the pending verdicts land, so the
+                # checkpoint payload captures the run exactly and the
+                # resume is bit-identical.
+                if pipe is not None:
+                    pipe.drain()
                 self._preempt_payload = self.checkpoint_payload(
                     list(self._pool)
                 )
@@ -1227,7 +1318,7 @@ class ShardedTpuBfsChecker(Checker):
                     >= self._checkpoint_min_interval
                 ):
                     with self._phase("checkpoint"):
-                        self.save_checkpoint(self._checkpoint_path, self._pool)
+                        self._save_checkpoint_maybe_async()
                     last_checkpoint = time.perf_counter()
                 chunks += 1
                 B_glob = G * A
@@ -1237,13 +1328,17 @@ class ShardedTpuBfsChecker(Checker):
                         _pow2ceil(
                             int((self._l0_count + B_glob) / (_MAX_LOAD * n))
                         ),
+                        defer_evict=pipe is not None,
                     )
                 # Occupancy-adaptive dispatch: the host pool count is exact
                 # (numpy rows), so the global chunk shrinks to n × the
                 # smallest per-device ladder rung holding the pending rows —
                 # a sparse frontier expands an n×bucket grid, not n×F_loc.
                 # _pool_take's round-robin interleave then gives every shard a
-                # dense live-lane prefix at that width.
+                # dense live-lane prefix at that width. (Async: after the
+                # coalescing barrier above, this count either matches the
+                # synchronous path's exactly, or is >= G with it — same
+                # bucket either way.)
                 got = min(self._pool_count, G)
                 width = G
                 bucket = None
@@ -1259,84 +1354,252 @@ class ShardedTpuBfsChecker(Checker):
                 chunk = self._pool_take(width)
                 dev = self._put_chunk(chunk)
 
-                attempt = 0
-                wave_generated = 0
-                wave_new = 0
-                self._wave_stale = 0
-                with self._tracer.span(
-                    "sharded_bfs.wave", wave=chunks
-                ) as sp, device_step_annotation("sharded_bfs.wave", chunks):
-                    while True:
-                        wave = self._call_wave(table, dev, depth_cap)
-                        table = wave["table"]
-                        if attempt == 0:
-                            wave_generated = int(
-                                self._pull(wave["generated"]).sum()
-                            )
-                            self._state_count += wave_generated
-                            self._max_depth = max(
-                                self._max_depth,
-                                int(self._pull(wave["max_depth"]).max()),
-                            )
-                            if props:
-                                hit = self._pull(wave["prop_hit"])
-                                phi = self._pull(wave["prop_hi"])
-                                plo = self._pull(wave["prop_lo"])
-                                for i, p in enumerate(props):
-                                    if p.name in self._discoveries_fp:
-                                        continue
-                                    for d in range(n):
-                                        if hit[d, i]:
-                                            self._discoveries_fp[p.name] = (
-                                                fp_to_int(phi[d, i], plo[d, i])
-                                            )
-                                            break
-                            if self._visitor is not None:
-                                self._visit_chunk(chunk)
-                        if self._cov is not None:
-                            # Mesh-summed coverage vector; a growth retry
-                            # re-expands the same chunk, so only the
-                            # fresh-based slices accumulate then.
-                            self._cov.consume_device(
-                                np.asarray(
-                                    self._pull(wave["cov"])
-                                ).sum(axis=0),
-                                self._cov_layout,
-                                first_attempt=(attempt == 0),
-                                max_depth=self._max_depth,
-                            )
-                        wave_new += self._harvest(wave)
-                        if not int(self._pull(wave["overflow"]).sum()):
-                            break
-                        if self._max_cap_loc is not None and attempt >= 8:
-                            # Pathological key skew: one shard overflows even
-                            # freshly evicted — a configuration error, not a
-                            # loop to spin in.
-                            raise RuntimeError(
-                                "a single wave's routed keys overflow one "
-                                "budget-capped shard after repeated "
-                                "evictions; raise hbm_budget_mib or shrink "
-                                "frontier_per_device"
-                            )
-                        table = self._grow_table(table, self._cap_loc * 2)
-                        attempt += 1
-                    self._record_wave_metrics(
-                        sp,
-                        width,
-                        wave_generated,
-                        wave_new,
-                        bucket=bucket,
-                        compaction_ratio=(got / width if bucket else None),
-                        live_lanes=got,
+                if pipe is None:
+                    table = self._wave_sync(
+                        table, chunk, dev, depth_cap, chunks, width,
+                        bucket, got,
                     )
-                    if self._cov is not None:
-                        self._cov.emit_wave_span()
+                else:
+                    # Bounded pending-verdict lane set.
+                    pipe.throttle()
+                    table = self._wave_async(
+                        table, dev, depth_cap, chunks, width, bucket, got,
+                    )
                 if self.warmup_seconds is None:
                     self.warmup_seconds = time.perf_counter() - self._t_start
                     self._wi.warmup.set(self.warmup_seconds)
                 # Re-ingest fresh rows for the next chunks.
                 del dev
+        if pipe is not None:
+            # Run-end epoch barrier: counters and the parent-fp log must
+            # be settled before the audit and the done flag.
+            pipe.drain()
         self._audit_table(table)
+
+    def _apply_wave_stats(self, wave, chunk=None):
+        """First-attempt device bookkeeping shared by the sync and async
+        wave paths (a growth retry re-expands the same chunk, so this
+        runs once per wave): generated/depth counters, discovery
+        fingerprints, and the visitor callback. ONE site on purpose —
+        the bit-identical guarantee depends on both paths applying the
+        same stats the same way. Returns the wave's generated count."""
+        props = self._properties
+        n = self._n
+        generated = int(self._pull(wave["generated"]).sum())
+        self._state_count += generated
+        self._max_depth = max(
+            self._max_depth, int(self._pull(wave["max_depth"]).max())
+        )
+        if props:
+            hit = self._pull(wave["prop_hit"])
+            phi = self._pull(wave["prop_hi"])
+            plo = self._pull(wave["prop_lo"])
+            for i, p in enumerate(props):
+                if p.name in self._discoveries_fp:
+                    continue
+                for d in range(n):
+                    if hit[d, i]:
+                        self._discoveries_fp[p.name] = fp_to_int(
+                            phi[d, i], plo[d, i]
+                        )
+                        break
+        if chunk is not None and self._visitor is not None:
+            self._visit_chunk(chunk)
+        return generated
+
+    def _wave_sync(self, table, chunk, dev, depth_cap, chunks, width,
+                   bucket, got):
+        """One wave's synchronous dispatch + harvest (the pre-async
+        body, factored out unchanged)."""
+        attempt = 0
+        wave_generated = 0
+        wave_new = 0
+        self._wave_stale = 0
+        with self._tracer.span(
+            "sharded_bfs.wave", wave=chunks
+        ) as sp, device_step_annotation("sharded_bfs.wave", chunks):
+            while True:
+                wave = self._call_wave(table, dev, depth_cap)
+                table = wave["table"]
+                if attempt == 0:
+                    wave_generated = self._apply_wave_stats(wave, chunk)
+                if self._cov is not None:
+                    # Mesh-summed coverage vector; a growth retry
+                    # re-expands the same chunk, so only the
+                    # fresh-based slices accumulate then.
+                    self._cov.consume_device(
+                        np.asarray(
+                            self._pull(wave["cov"])
+                        ).sum(axis=0),
+                        self._cov_layout,
+                        first_attempt=(attempt == 0),
+                        max_depth=self._max_depth,
+                    )
+                wave_new += self._harvest(wave)
+                if not int(self._pull(wave["overflow"]).sum()):
+                    break
+                if self._max_cap_loc is not None and attempt >= 8:
+                    # Pathological key skew: one shard overflows even
+                    # freshly evicted — a configuration error, not a
+                    # loop to spin in.
+                    raise RuntimeError(
+                        "a single wave's routed keys overflow one "
+                        "budget-capped shard after repeated "
+                        "evictions; raise hbm_budget_mib or shrink "
+                        "frontier_per_device"
+                    )
+                table = self._grow_table(table, self._cap_loc * 2)
+                attempt += 1
+            self._record_wave_metrics(
+                sp,
+                width,
+                wave_generated,
+                wave_new,
+                bucket=bucket,
+                compaction_ratio=(got / width if bucket else None),
+                live_lanes=got,
+            )
+            if self._cov is not None:
+                self._cov.emit_wave_span()
+        return table
+
+    def _wave_async(self, table, dev, depth_cap, chunks, width, bucket,
+                    got):
+        """One wave's async dispatch (checker thread): device stats,
+        discoveries, growth retries — everything the next dispatch
+        decision depends on — while each attempt's harvest verdict is
+        submitted to the pipeline worker BEFORE any growth/eviction
+        that follows it (the tier must see probes and evictions in the
+        synchronous order; see TpuBfsChecker._consume_wave_async)."""
+        attempt = 0
+        ctx = {"wave_new": 0, "stale": 0, "generated": 0}
+        with device_step_annotation("sharded_bfs.wave", chunks):
+            while True:
+                wave = self._call_wave(table, dev, depth_cap)
+                table = wave["table"]
+                if attempt == 0:
+                    ctx["generated"] = self._apply_wave_stats(wave)
+                if self._cov is not None:
+                    self._cov.consume_device(
+                        np.asarray(self._pull(wave["cov"])).sum(axis=0),
+                        self._cov_layout,
+                        first_attempt=(attempt == 0),
+                        max_depth=self._max_depth,
+                    )
+                n_new = self._pull(wave["n_new"])
+                total = int(n_new.sum())
+                self._l0_count += total
+                final = not int(self._pull(wave["overflow"]).sum())
+                # Only jobs that can grow the pool hold up the
+                # coalescing barrier (see _explore_waves); count this
+                # one in BEFORE it is queued — incrementing after
+                # submit could let the job's decrement land first and
+                # the barrier miss a genuinely pending verdict.
+                with self._pool_lock:
+                    self._inflight_verdicts += 1
+                try:
+                    # Point-in-time captures: the live l0/capacity/depth
+                    # fields may describe a later wave by verdict time.
+                    self._pipe.submit(
+                        lambda w=wave, nn=n_new, t=total, f=final,
+                        warm=self.warmup_seconds is not None,
+                        st=(
+                            self._l0_count,
+                            self._n * self._cap_loc,
+                            self._max_depth,
+                        ):
+                            self._harvest_verdict(
+                                ctx, w, nn, t, f, chunks, width, bucket,
+                                got, warm, st,
+                            )
+                    )
+                except BaseException:
+                    # A poisoned submit never enqueues the job (whose
+                    # finally would decrement) — rebalance here.
+                    with self._pool_lock:
+                        self._inflight_verdicts -= 1
+                    raise
+                if final:
+                    if self._cov is not None:
+                        self._cov.emit_wave_span()
+                    return table
+                if self._max_cap_loc is not None and attempt >= 8:
+                    raise RuntimeError(
+                        "a single wave's routed keys overflow one "
+                        "budget-capped shard after repeated "
+                        "evictions; raise hbm_budget_mib or shrink "
+                        "frontier_per_device"
+                    )
+                table = self._grow_table(
+                    table, self._cap_loc * 2, defer_evict=True
+                )
+                attempt += 1
+
+    def _harvest_verdict(self, ctx, wave, n_new, total, final, wave_no,
+                         width, bucket, got, warm, state):
+        """Pipeline-worker half of a sharded wave: pulls the compacted
+        fresh rows, probes the shard tiers (exact here — every eviction
+        is applied on this thread, in submission order), logs the
+        survivors, and re-pools them at the tail. The final attempt
+        emits the ``sharded_bfs.wave`` span + telemetry the monitor's
+        estimator consumes."""
+        def verdict():
+            if not total:
+                return
+            # _tier_active() inside _harvest_rows is exact HERE: every
+            # eviction is applied on this same thread, in submission
+            # order (the merge fence).
+            survivors, n_stale = self._harvest_rows(
+                wave, n_new, overlapped=True
+            )
+            ctx["stale"] += n_stale
+            ctx["wave_new"] += survivors
+
+        try:
+            if not final:
+                verdict()
+                return
+            # Covers the HOST VERDICT only (the device half overlaps
+            # later waves) — flagged so trace readers don't compare its
+            # dur against sync wave walls.
+            with self._tracer.span(
+                "sharded_bfs.wave", wave=wave_no, async_verdict=True
+            ) as sp:
+                verdict()
+                self._record_wave_metrics(
+                    sp, width, ctx["generated"], ctx["wave_new"],
+                    bucket=bucket,
+                    compaction_ratio=(got / width if bucket else None),
+                    live_lanes=got, stale=ctx["stale"], warm=warm,
+                    state=state,
+                )
+        finally:
+            # Decrement even on a verdict error: the barrier predicate
+            # must not wedge the checker on a job that will never
+            # append (the error itself surfaces via drain/submit).
+            with self._pool_lock:
+                self._inflight_verdicts -= 1
+
+    def _save_checkpoint_maybe_async(self, batches=None):
+        """Checkpoint at an epoch boundary: payload built synchronously
+        after the barrier; in async mode the pickle + rename ride the
+        pipeline worker (see TpuBfsChecker._save_checkpoint_maybe_async
+        for why that is safe). ``batches`` overrides the wave-mode host
+        pool (the deep path passes ring exports); the pool itself is
+        snapshotted only AFTER the barrier — in-flight verdicts append
+        survivor rows during the drain."""
+        if self._pipe is None:
+            self.save_checkpoint(
+                self._checkpoint_path,
+                batches if batches is not None else self._pool,
+            )
+            return
+        self._pipe.drain()
+        payload = self.checkpoint_payload(
+            list(batches) if batches is not None else list(self._pool)
+        )
+        path = self._checkpoint_path
+        self._pipe.submit(lambda: self._checkpoint_write(path, payload))
 
     def _call_wave(self, table, dev, depth_cap):
         """Wave through an AOT-compiled executable (keyed by local table
@@ -1714,9 +1977,12 @@ class ShardedTpuBfsChecker(Checker):
 
     def _checkpoint_rings(self, pool, head, count):
         """Deep-mode checkpoint: exports the rings into one host row-batch
-        and saves it alongside any host-pool leftovers."""
-        self.save_checkpoint(
-            self._checkpoint_path, self._rings_pool_batches(pool, head, count)
+        and saves it alongside any host-pool leftovers. Async mode
+        defers the pickle + rename to the pipeline worker, same as the
+        wave path (deep drains carry no verdicts, so the barrier is
+        instant)."""
+        self._save_checkpoint_maybe_async(
+            self._rings_pool_batches(pool, head, count)
         )
 
     def _seed(self):
@@ -1977,6 +2243,18 @@ class ShardedTpuBfsChecker(Checker):
         self._l0_count += total
         if not total:
             return total
+        survivors, n_stale = self._harvest_rows(wave, n_new)
+        self._wave_stale += n_stale
+        return survivors
+
+    def _harvest_rows(self, wave, n_new, overlapped=False):
+        """Pull + probe + log + re-pool one wave's compacted fresh
+        rows. ONE site for the sync harvest and the async verdict job —
+        the key selection, stale gather, and row order must never
+        diverge between them (the bit-identical guarantee).
+        ``overlapped`` picks the attribution ledger (worker-thread time
+        is shadowed, not serial wall). Returns
+        ``(survivors, n_stale)``."""
         hi = self._pull(wave["new_hi"])
         # Per-device candidate-lane width of THIS wave (bucketed chunks
         # dispatch below G, so the width is the wave's, not the config's).
@@ -1998,16 +2276,18 @@ class ShardedTpuBfsChecker(Checker):
                 self._pull(wave["new_khi"]), self._pull(wave["new_klo"])
             )
         idx = np.flatnonzero(sel)
+        n_stale = 0
         if self._tiers and self._tier_active():
-            with self._phase("host_probe"):
+            phase = self._phase_overlapped if overlapped else self._phase
+            with phase("host_probe"):
                 keys = (key64 if key64 is not None else child64)[idx]
                 stale = self._probe_tiers(keys)
-            self._wave_stale += int(stale.sum())
+            n_stale = int(stale.sum())
             idx = idx[~stale]
         survivors = len(idx)
         self._unique_count += survivors
         if not survivors:
-            return 0
+            return 0, n_stale
         self._wave_log.append((child64[idx], par64[idx]))
         if self._symmetry_enabled:
             self._key_log.append(key64[idx])
@@ -2020,33 +2300,53 @@ class ShardedTpuBfsChecker(Checker):
                 "depth": depth[idx].astype(np.int32),
             }
         )
-        return survivors
+        return survivors, n_stale
 
     def _record_wave_metrics(
         self, span, frontier, generated, n_new, bucket=None,
-        compaction_ratio=None, live_lanes=None,
+        compaction_ratio=None, live_lanes=None, stale=None, warm=None,
+        state=None,
     ):
         """One host-visible wave's telemetry (the shared bundle does the
         recording; occupancy is the shard tables' resident load — under
-        tiering the global unique count outgrows the devices)."""
+        tiering the global unique count outgrows the devices).
+        ``stale``/``warm``/``state`` (= (l0, total capacity, max_depth))
+        are point-in-time captures the async verdict job passes in — by
+        verdict time the live fields describe a later wave (a deferred
+        eviction even resets l0 to 0); the synchronous path reads the
+        live fields."""
         extra = {}
         if live_lanes is not None:
             # Live (pre-padding) pending rows: the monitor's frontier fit
             # reads this over the dispatch-width `frontier` when present.
             extra["live_lanes"] = live_lanes
+        if state is not None:
+            l0, capacity, depth = state
+        else:
+            l0, capacity, depth = (
+                self._l0_count, self._n * self._cap_loc, self._max_depth
+            )
         if self._si is not None:
-            self._si.set_l0(self._l0_count)
-            extra["storage_stale"] = self._wave_stale
+            self._si.set_l0(l0)
+            extra["storage_stale"] = (
+                stale if stale is not None else self._wave_stale
+            )
+            # Worker-exact: tier mutations are FIFO-ordered, so at this
+            # job's position the tier state matches the synchronous
+            # path's.
             extra["storage_fps"] = sum(t.total_fps for t in self._tiers)
+        steady = (
+            warm if warm is not None else self.warmup_seconds is not None
+        )
         self._wi.record(
             span,
             frontier=frontier,
             generated=generated,
             n_new=n_new,
-            occupancy=self._l0_count / (self._n * self._cap_loc),
-            capacity=self._n * self._cap_loc,
-            max_depth=self._max_depth,
-            phase="warmup" if self.warmup_seconds is None else "steady",
+            occupancy=l0 / capacity,
+            capacity=capacity,
+            max_depth=depth,
+            phase="steady" if steady else "warmup",
             bucket=bucket,
             compaction_ratio=compaction_ratio,
             **extra,

@@ -13,7 +13,18 @@ the device table OR any run here. The checkers therefore stay
 bit-identical to the single-tier path — each key's first global
 appearance is the only one that survives the two-phase filter.
 
-All batched numpy, single-threaded (called from the checker worker only).
+All batched numpy. Ownership under the async pipelined wave engine
+(``async_pipeline=True``): every *mutation* (evict, and the merges and
+spills it triggers) and every *probe* is issued from ONE thread — the
+checker's host pipeline worker — in the exact order the synchronous
+path would issue them, which is what keeps a probe from ever observing
+an eviction submitted after it (checker/pipeline.py, the FIFO "merge
+fence"). The store still carries its own reentrant lock as a second
+fence: runs are immutable once built (``FingerprintRun`` never mutates
+in place — merges build NEW runs and swap the tier lists), so the lock
+only has to make the list swaps and the probe's run iteration atomic,
+and cross-thread readers (checkpoint export at an epoch barrier, the
+flight recorder's stats pull mid-crash) can never see a torn tier.
 Telemetry rides a shared ``StorageInstruments`` bundle so the sharded
 checker's per-shard stores aggregate into one set of gauges.
 """
@@ -21,6 +32,7 @@ checker's per-shard stores aggregate into one set of gauges.
 from __future__ import annotations
 
 import os
+import threading
 from typing import List, Optional
 
 import numpy as np
@@ -226,6 +238,9 @@ class TieredVisitedStore:
         self._span_prefix = self._instr.prefix
         self._shard = shard
         self._seq = 0
+        # The merge fence (see the module docstring): reentrant because
+        # evict() holds it across the merges/spills it triggers.
+        self._fence = threading.RLock()
         self.l1: List[FingerprintRun] = []
         self.l2: List[FingerprintRun] = []
 
@@ -260,7 +275,7 @@ class TieredVisitedStore:
         fps = np.unique(np.asarray(fps, np.uint64))
         if len(fps) == 0:
             return 0
-        with self._tracer.span(
+        with self._fence, self._tracer.span(
             f"{self._span_prefix}.evict", fps=int(len(fps)),
             shard=self._shard,
         ):
@@ -349,7 +364,7 @@ class TieredVisitedStore:
         hits = {"l1": 0, "l2": 0}
         bloom_probed = 0
         bloom_fp = 0
-        with self._tracer.span(
+        with self._fence, self._tracer.span(
             f"{self._span_prefix}.probe", keys=int(len(fps)),
             shard=self._shard,
         ) as sp:
@@ -391,22 +406,30 @@ class TieredVisitedStore:
 
     def export_state(self) -> dict:
         """Self-contained checkpoint payload (L2 payloads are read back in
-        — a spill file may not exist on the restoring machine)."""
-        return {
-            "seq": self._seq,
-            "l1": [r.to_state() for r in self.l1],
-            "l2": [r.to_state() for r in self.l2],
-        }
+        — a spill file may not exist on the restoring machine). The
+        per-run state dicts are immutable snapshots (runs never mutate in
+        place), so a payload exported at an epoch barrier stays valid
+        even if later evictions merge or spill the live tier lists —
+        what lets the async engine hand the pickle to its worker."""
+        with self._fence:
+            return {
+                "seq": self._seq,
+                "l1": [r.to_state() for r in self.l1],
+                "l2": [r.to_state() for r in self.l2],
+            }
 
     def load_state(self, state: dict) -> None:
         """Restores runs from a checkpoint (CRC-validated per run); L2
         runs re-spill to this store's ``spill_dir`` when it has one, else
         they stay host-resident (still budget-enforced on the next
         eviction)."""
-        self._seq = int(state.get("seq", 0))
-        self.l1 = [FingerprintRun.from_state(s) for s in state.get("l1", [])]
-        l2 = [FingerprintRun.from_state(s) for s in state.get("l2", [])]
-        if self._spill_dir is not None:
-            l2 = [self._spill_run(r) for r in l2]
-        self.l2 = l2
+        with self._fence:
+            self._seq = int(state.get("seq", 0))
+            self.l1 = [
+                FingerprintRun.from_state(s) for s in state.get("l1", [])
+            ]
+            l2 = [FingerprintRun.from_state(s) for s in state.get("l2", [])]
+            if self._spill_dir is not None:
+                l2 = [self._spill_run(r) for r in l2]
+            self.l2 = l2
         self._instr.refresh()

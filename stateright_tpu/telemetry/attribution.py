@@ -25,6 +25,28 @@ OVERRUNNING the wall (clock skew, double counting) — tracked as
 nest: an inner ``phase()`` opened while another is open records nothing,
 so call sites can wrap helpers without auditing their callees.
 
+**Overlapped execution** (the async pipelined wave engine,
+``async_pipeline=True``): host-tier work runs on a worker thread UNDER
+device compute, so its time is a new phase class — ``overlapped`` —
+recorded through the thread-safe ``overlapped(name)`` window instead of
+``phase(name)``. Overlapped time is deliberately NOT part of any wave
+window's phase set (it is wall-clock the run never paid serially), so
+the sum-to-wall invariant stays exact per wave and the 5% tolerance
+check is mode-aware by construction: in overlap mode the in-window
+phases are device + the few remaining serial host sections, the gap is
+the residual as before, and the shadowed host time reports separately
+as ``overlapped_s`` (per phase). Each overlapped window also emits a
+``<prefix>.pipeline.overlapped`` trace span so ``scripts/gap_report.py``
+can render the ACHIEVED overlap next to the predicted headroom.
+
+``overlapped_s`` is worker-side host time — an UPPER bound on the
+wall-clock actually saved: the fraction executed while the checker
+thread was itself blocked in an epoch-barrier drain (checkpoint
+boundaries, queue-empty waits) ran concurrently with an idle device,
+not under compute. The realized saving is what ``utilization`` /
+wall-clock deltas measure directly; compare async-off vs async-on legs
+(``bench.py --async-ab``) for the ground truth.
+
 Results surface everywhere the existing plumbing reaches: per-phase
 ``<prefix>.pipeline.*`` registry counters/gauges, one
 ``<prefix>.pipeline`` trace span per wave (args carry ``wall_ms``,
@@ -54,6 +76,7 @@ import glob
 import gzip
 import json
 import os
+import threading
 import time
 from typing import Dict, List, Optional
 
@@ -113,6 +136,39 @@ class _Phase:
             attr = self._attr
             attr._open_phase = None
             attr._add_phase(self.name, attr._clock() - self._t0)
+
+
+class _OverlappedPhase:
+    """One host-tier window running on the async pipeline's worker
+    thread, shadowed under device compute. Thread-safe (its ledger is
+    lock-guarded and it never touches the wave window's ``_open_phase``
+    state) and reentrant across threads by construction: every window
+    records, because overlapped windows measure real concurrent work
+    rather than partitioning one thread's wall. Emits a
+    ``<prefix>.pipeline.overlapped`` span so trace readers see the
+    achieved overlap without the registry."""
+
+    __slots__ = ("_attr", "name", "_t0", "_span")
+
+    def __init__(self, attr: "WaveAttribution", name: str):
+        self._attr = attr
+        self.name = name
+
+    def __enter__(self) -> "_OverlappedPhase":
+        attr = self._attr
+        self._span = attr._tracer.span(
+            f"{attr.prefix}.pipeline.overlapped", phase=self.name
+        )
+        self._span.__enter__()
+        self._t0 = attr._clock()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        attr = self._attr
+        dt = max(0.0, attr._clock() - self._t0)
+        attr._add_overlapped(self.name, dt)
+        self._span.set(**{f"{self.name}_ms": dt * 1e3})
+        self._span.__exit__(exc_type, exc, tb)
 
 
 class _Wave:
@@ -203,6 +259,13 @@ class WaveAttribution:
         # ledger invariant on every resumed run.
         self._outside: Dict[str, float] = {}
         self._phase_counters: Dict[str, object] = {}
+        # Overlapped ledger (async pipelined engine): host-tier time the
+        # worker thread spent shadowed under device compute, per phase.
+        # Lock-guarded — the worker and checker threads both reach it.
+        self._overlapped: Dict[str, float] = {}
+        self._ov_lock = threading.Lock()
+        self._ov_counters: Dict[str, object] = {}
+        self._overlap_mode = False
         self._wall_s = 0.0
         self._gap_s = 0.0
         self._overrun_s = 0.0
@@ -236,6 +299,19 @@ class WaveAttribution:
     def phase(self, name: str) -> _Phase:
         return _Phase(self, name)
 
+    def overlapped(self, name: str) -> _OverlappedPhase:
+        """A host-tier window running on the async pipeline's worker
+        thread — recorded into the separate ``overlapped`` ledger, never
+        into any wave window (see the module docstring's mode-aware
+        invariant note)."""
+        return _OverlappedPhase(self, name)
+
+    def set_overlap_mode(self, on: bool = True) -> None:
+        """Marks the ledger as describing a pipelined run (reported as
+        ``overlap_mode``): readers must not expect the host phases
+        inside the wave windows — they ride ``overlapped_s``."""
+        self._overlap_mode = bool(on)
+
     def fence(self, tree) -> None:
         """Blocks until every device array in ``tree`` is ready, so the
         surrounding phase window measures real work instead of async
@@ -263,6 +339,25 @@ class WaveAttribution:
             )
             self._phase_counters[name] = c
         c.inc(dt)
+
+    def _add_overlapped(self, name: str, dt: float) -> None:
+        with self._ov_lock:
+            self._overlapped[name] = self._overlapped.get(name, 0.0) + dt
+            c = self._ov_counters.get(name)
+            if c is None:
+                c = self._registry.counter(
+                    f"{self.prefix}.pipeline.overlapped.{name}_seconds"
+                )
+                self._ov_counters[name] = c
+            total = self._ov_counters.get("__total__")
+            if total is None:
+                total = self._registry.counter(
+                    f"{self.prefix}.pipeline.overlapped_seconds"
+                )
+                self._ov_counters["__total__"] = total
+        # Counters carry their own locks; inc outside ours.
+        c.inc(dt)
+        total.inc(dt)
 
     def abort(self) -> None:
         """Finalizes any window a crashing loop left open (called from
@@ -343,6 +438,8 @@ class WaveAttribution:
         device = phases.get("device", 0.0)
         host = sum(phases.get(p, 0.0) for p in HOST_OVERLAPPABLE_PHASES)
         headroom = min(host, device)
+        with self._ov_lock:
+            overlapped = dict(sorted(self._overlapped.items()))
         out: Dict[str, object] = {
             "prefix": self.prefix,
             "waves": self._waves,
@@ -368,7 +465,14 @@ class WaveAttribution:
                 "predicted_wall_s": wall - headroom,
             },
             "device_split": self.device_split,
+            # Overlapped execution (async pipelined engine): host time
+            # shadowed under device compute — NOT in phases_s, so the
+            # sum-to-wall invariant above stays exact in both modes.
+            "overlap_mode": self._overlap_mode,
         }
+        if overlapped or self._overlap_mode:
+            out["overlapped_s"] = overlapped
+            out["overlapped_total_s"] = sum(overlapped.values())
         if self._outside:
             # Phase time outside any wave window (seed/restore): real,
             # but not part of any wave's wall — reported separately so

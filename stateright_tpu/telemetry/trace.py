@@ -150,6 +150,15 @@ class Tracer:
         self._ring: deque = deque(maxlen=ring_capacity)
         self._sinks: List[JsonlSink] = []
         self.enabled = True
+        # Emit lock: the async wave engine's host worker closes wave
+        # spans concurrently with the checker thread's drain/compile
+        # spans (and the monitor's tracer-sink tap consumes both), so
+        # the ring append + sink fan-out must be one atomic step —
+        # unlocked, a tap could observe event B before event A from the
+        # thread that emitted A first, and interleaved sink writes
+        # would tear. deque.append alone is GIL-atomic; the
+        # append-then-fan-out sequence is not.
+        self._emit_lock = threading.Lock()
 
     # -- recording ---------------------------------------------------------
 
@@ -178,9 +187,14 @@ class Tracer:
         )
 
     def _emit(self, event: Dict) -> None:
-        self._ring.append(event)
-        for sink in self._sinks:
-            sink.write_event(event)
+        # Never held while a signal handler might re-enter: the flight
+        # recorder's events() read deliberately stays lock-free (retry
+        # loop below) so a SIGTERM dump cannot deadlock against a
+        # checker thread parked mid-emit.
+        with self._emit_lock:
+            self._ring.append(event)
+            for sink in self._sinks:
+                sink.write_event(event)
 
     # -- sinks and inspection ----------------------------------------------
 

@@ -1,0 +1,267 @@
+"""Async pipelined wave engine: the host-side machinery in isolation.
+
+The bit-identical equivalence of ``async_pipeline=True`` runs lives in
+``tests/test_storage_equivalence.py`` (async legs); this module covers
+the machinery underneath it:
+
+- ``HostPipeline`` (checker/pipeline.py): FIFO order, the drain epoch
+  barrier, the bounded pending-verdict throttle, and error poisoning.
+- The tracer's emit path under two threads (the worker closes wave
+  spans concurrently with the checker thread) with the monitor's
+  tracer-sink tap and a flight-recorder-style ring read racing it.
+- The attribution engine's ``overlapped`` phase class: thread-safe,
+  never part of a wave window, mode-aware report fields.
+"""
+
+import json
+import threading
+import time
+
+import pytest
+
+from stateright_tpu.checker.pipeline import HostPipeline
+from stateright_tpu.telemetry import metrics_registry
+from stateright_tpu.telemetry.attribution import WaveAttribution
+from stateright_tpu.telemetry.trace import JsonlSink, Tracer
+
+
+# -- HostPipeline ----------------------------------------------------------
+
+
+def test_pipeline_fifo_and_drain():
+    pipe = HostPipeline(name="t-fifo")
+    seen = []
+    for i in range(100):
+        pipe.submit(lambda i=i: seen.append(i))
+    pipe.drain()
+    assert seen == list(range(100)), "jobs must run in submission order"
+    assert pipe.pending() == 0
+    assert pipe.submitted == 100
+    pipe.close()
+
+
+def test_pipeline_drain_is_epoch_barrier():
+    pipe = HostPipeline(name="t-barrier")
+    gate = threading.Event()
+    done = []
+    pipe.submit(gate.wait)
+    pipe.submit(lambda: done.append(1))
+    assert pipe.pending() == 2
+    gate.set()
+    pipe.drain()
+    assert done == [1]
+    pipe.close()
+
+
+def test_pipeline_throttle_bounds_backlog():
+    pipe = HostPipeline(name="t-throttle", max_pending=2)
+    gate = threading.Event()
+    pipe.submit(gate.wait)
+    pipe.submit(lambda: None)
+    # Backlog == max_pending: throttle returns immediately.
+    pipe.throttle()
+    pipe.submit(lambda: None)
+    t = threading.Thread(target=pipe.throttle)
+    t.start()
+    t.join(timeout=0.2)
+    assert t.is_alive(), "throttle must block while backlog > max_pending"
+    gate.set()
+    t.join(timeout=5.0)
+    assert not t.is_alive()
+    pipe.drain()
+    pipe.close()
+
+
+def test_pipeline_error_poisons_and_surfaces():
+    pipe = HostPipeline(name="t-poison")
+    ran = []
+
+    def boom():
+        raise ValueError("verdict failed")
+
+    pipe.submit(boom)
+    try:
+        # Either outcome is correct, and which one happens is a race:
+        # enqueued-then-skipped (worker hadn't run boom yet) or refused
+        # outright (already poisoned).
+        pipe.submit(lambda: ran.append(1))
+    except RuntimeError:
+        pass
+    with pytest.raises(RuntimeError) as ei:
+        pipe.drain()
+    assert isinstance(ei.value.__cause__, ValueError)
+    assert ran == [], "jobs after a failure must not run"
+    with pytest.raises(RuntimeError):
+        pipe.submit(lambda: None)
+    pipe.close()
+
+
+def test_pipeline_close_idempotent():
+    pipe = HostPipeline(name="t-close")
+    pipe.submit(lambda: None)
+    pipe.close()
+    pipe.close()
+    with pytest.raises(RuntimeError):
+        pipe.submit(lambda: None)
+
+
+# -- two-thread tracer smoke (satellite: ring append lock + monitor tap) ---
+
+
+def test_tracer_two_thread_emit_with_monitor_tap(tmp_path):
+    """Two threads emit wave spans into one tracer feeding a JSONL sink
+    AND the monitor's tracer-sink tap, while a third reader does
+    flight-recorder-style ring reads. No torn lines, no sink errors, no
+    lost events at the sinks."""
+    from stateright_tpu.telemetry.server import MonitorCore
+
+    registry = metrics_registry("t-two-thread")
+    registry.reset()
+    tracer = Tracer()
+    path = tmp_path / "events.jsonl"
+    sink = tracer.add_sink(JsonlSink(str(path)))
+    core = MonitorCore(registry=registry, tracer=tracer)
+    # 2 × 150 spans: enough to interleave constantly, small enough to
+    # respect the tier-1 wall budget (the sink flushes per write).
+    N = 150
+    stop = threading.Event()
+
+    def emitter(tid):
+        for i in range(N):
+            with tracer.span(
+                "tpu_bfs.wave", wave=i, thread=tid
+            ) as sp:
+                sp.set(new_unique=1, generated=2, frontier=8)
+
+    def ring_reader():
+        while not stop.is_set():
+            events = tracer.events()
+            assert isinstance(events, list)
+            time.sleep(0.001)
+
+    reader = threading.Thread(target=ring_reader)
+    reader.start()
+    threads = [
+        threading.Thread(target=emitter, args=(t,)) for t in range(2)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    stop.set()
+    reader.join()
+    tracer.remove_sink(core, close=False)
+    tracer.remove_sink(sink)
+    core.close()
+
+    lines = path.read_text().strip().splitlines()
+    assert len(lines) == 2 * N, "sink must see every span exactly once"
+    for line in lines:
+        json.loads(line)  # no torn/interleaved writes
+    snap = registry.snapshot()
+    assert snap.get("monitor.sink_errors", 0) == 0
+    assert snap.get("monitor.wave_events", 0) == 2 * N
+
+
+# -- attribution: overlapped phase class -----------------------------------
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+        self._lock = threading.Lock()
+
+    def __call__(self):
+        with self._lock:
+            return self.t
+
+    def advance(self, dt):
+        with self._lock:
+            self.t += dt
+
+
+def test_attribution_overlapped_ledger():
+    clock = FakeClock()
+    registry = metrics_registry("t-overlap")
+    registry.reset()
+    attr = WaveAttribution("tpu_bfs", clock=clock, registry=registry,
+                           tracer=Tracer())
+    attr.set_overlap_mode(True)
+    # One wave window on the "checker thread": 1.0s wall, 0.6s device.
+    with attr.wave():
+        with attr.phase("device"):
+            clock.advance(0.6)
+        # Worker-thread host work DURING the window must not join the
+        # window's phase set (it is shadowed time, not serial wall) —
+        # and must not trip the non-reentrant phase guard.
+        with attr.overlapped("host_probe"):
+            clock.advance(0.25)
+        with attr.overlapped("checkpoint"):
+            clock.advance(0.15)
+    report = attr.report()
+    assert report["overlap_mode"] is True
+    assert report["overlapped_s"]["host_probe"] == pytest.approx(0.25)
+    assert report["overlapped_s"]["checkpoint"] == pytest.approx(0.15)
+    assert report["overlapped_total_s"] == pytest.approx(0.40)
+    # The wave's wall includes the time the fake clock advanced inside
+    # the overlapped windows (single-threaded fake), but phases_s must
+    # only carry the device phase — overlapped time lands in gap, and
+    # the ledger never overruns (mode-aware invariant).
+    assert set(report["phases_s"]) == {"device"}
+    assert report["phases_s"]["device"] == pytest.approx(0.6)
+    assert report["overrun_s"] == 0.0
+    assert report["within_tolerance"] is True
+    snap = registry.snapshot()
+    assert snap["tpu_bfs.pipeline.overlapped_seconds"] == pytest.approx(0.4)
+    assert snap["tpu_bfs.pipeline.overlapped.host_probe_seconds"] == (
+        pytest.approx(0.25)
+    )
+
+
+def test_attribution_overlapped_thread_safe():
+    """Overlapped windows record from many threads concurrently without
+    losing time (the ledger is lock-guarded; wall clock here)."""
+    registry = metrics_registry("t-overlap-mt")
+    registry.reset()
+    attr = WaveAttribution("tpu_bfs", registry=registry, tracer=Tracer())
+
+    def work():
+        for _ in range(50):
+            with attr.overlapped("host_probe"):
+                pass
+
+    threads = [threading.Thread(target=work) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    report = attr.report()
+    assert report["overlapped_s"]["host_probe"] >= 0.0
+    # 200 windows; each inc'd the counter exactly once.
+    spans = [
+        e for e in attr._tracer.events()
+        if e["name"] == "tpu_bfs.pipeline.overlapped"
+    ]
+    assert len(spans) == 200
+
+
+def test_async_pipeline_rejects_visitor():
+    """Per-chunk visitors reconstruct paths through verdicts the
+    pipeline defers — the combination must refuse loudly, not corrupt."""
+    from stateright_tpu.models.two_phase_commit import TwoPhaseSys
+
+    class V:
+        def visit(self, model, path):
+            pass
+
+    with pytest.raises(ValueError, match="async_pipeline"):
+        (
+            TwoPhaseSys(3)
+            .checker()
+            .visitor(V())
+            .spawn_tpu_bfs(
+                frontier_capacity=16,
+                table_capacity=1 << 12,
+                async_pipeline=True,
+            )
+        )

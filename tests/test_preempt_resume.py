@@ -176,9 +176,9 @@ class _PreemptDuringEviction(TpuBfsChecker):
     complete, the yield point honors the request at the next boundary,
     and the payload must carry the freshly-written storage tier."""
 
-    def _evict_l0(self, table):
+    def _evict_l0(self, table, defer=False):
         self.request_preempt()
-        return super()._evict_l0(table)
+        return super()._evict_l0(table, defer=defer)
 
 
 def test_preempt_mid_eviction_resume(uninterrupted_2pc4):

@@ -8,9 +8,13 @@ filter, and the survivor gather preserves lane order, so the frontier
 sequence never diverges (storage/__init__.py).
 
 Fast lane: 2pc-4 (materializing pipeline, deep-drain→wave handoff),
-2pc-4 under symmetry (orbit-key probe path), and a mid-eviction
-checkpoint resume. Slow lane: the 2pc-5 acceptance run, ABD with
-``expand_fps`` on/off, and the sharded checker with disk spill (L2).
+2pc-4 under symmetry (orbit-key probe path), a mid-eviction checkpoint
+resume, plus the async-pipeline twins (``async_pipeline=True``: probe/
+evict/checkpoint on the host worker, survivors one wave late — must
+stay bit-identical, including a checkpoint taken mid-pipeline then
+resumed). Slow lane: the 2pc-5 acceptance run (async off AND on), ABD
+with ``expand_fps`` on/off × async off/on, and the sharded checker
+with disk spill (L2), async off/on.
 """
 
 import io
@@ -36,16 +40,14 @@ def budget_for_table(rows: int) -> float:
     return ((rows + 128) * 8) / (1 << 20)
 
 
-def min_table_rows(frontier: int, actions: int, load=0.55) -> int:
-    return 1 << math.ceil(math.log2(frontier * actions / load + 1))
-
-
-def tiny_budget(model, frontier: int, load=0.55) -> float:
+def tiny_budget(model, frontier: int) -> float:
     """The smallest admissible ``hbm_budget_mib`` for this model at this
-    frontier width — the maximum eviction pressure the checker accepts."""
-    return budget_for_table(
-        min_table_rows(frontier, model.packed_action_count(), load)
-    )
+    frontier width — the maximum eviction pressure the checker accepts
+    (the shared library definition, so a load-factor change cannot
+    silently stop these budgets from binding)."""
+    from stateright_tpu.checker.tpu import min_admissible_hbm_budget_mib
+
+    return min_admissible_hbm_budget_mib(model, frontier)
 
 
 @pytest.fixture(scope="module")
@@ -95,6 +97,73 @@ def test_budget_identical_2pc4(unbounded_2pc4):
     _assert_identical(budgeted, unbounded_2pc4, min_evictions=2)
     assert budgeted.unique_state_count() == 1568
     budgeted.assert_properties()
+
+
+def test_async_pipeline_identical_2pc4(unbounded_2pc4):
+    """Async pipelined wave engine under eviction pressure: the host
+    worker applies every probe/evict verdict one wave late, yet counts,
+    depths, discoveries, and the golden reporter must match the
+    unbounded synchronous run exactly (README "Async pipeline")."""
+    metrics_registry().reset()
+    budgeted = (
+        TwoPhaseSys(4)
+        .checker()
+        .spawn_tpu_bfs(
+            frontier_capacity=16,
+            table_capacity=1 << 12,
+            hbm_budget_mib=tiny_budget(TwoPhaseSys(4), 16),
+            async_pipeline=True,
+        )
+        .join()
+    )
+    _assert_identical(budgeted, unbounded_2pc4, min_evictions=2)
+    assert budgeted.unique_state_count() == 1568
+    budgeted.assert_properties()
+
+
+def test_async_checkpoint_mid_pipeline_resume(tmp_path, unbounded_2pc4):
+    """A checkpoint taken mid-pipeline (epoch barrier drains in-flight
+    verdicts, payload snapshots AFTER the barrier, pickle rides the
+    worker) must restore into a run that finishes bit-identical — the
+    survivors that landed during the barrier's drain must be in the
+    payload's chunk list, not just its counters."""
+    ckpt = tmp_path / "2pc4-async.ckpt"
+    budget = tiny_budget(TwoPhaseSys(4), 16)
+    metrics_registry().reset()
+    first = (
+        TwoPhaseSys(4)
+        .checker()
+        .target_state_count(2500)  # stop early, mid-space
+        .spawn_tpu_bfs(
+            frontier_capacity=16,
+            table_capacity=1 << 12,
+            hbm_budget_mib=budget,
+            checkpoint_path=str(ckpt),
+            checkpoint_every_chunks=4,
+            async_pipeline=True,
+        )
+        .join()
+    )
+    assert first.worker_error() is None
+    assert first.unique_state_count() < 1568
+    with open(ckpt, "rb") as f:
+        payload = pickle.load(f)
+    assert payload["version"] == 2
+    resumed = (
+        TwoPhaseSys(4)
+        .checker()
+        .spawn_tpu_bfs(
+            frontier_capacity=16,
+            table_capacity=1 << 12,
+            hbm_budget_mib=budget,
+            resume_from=str(ckpt),
+            async_pipeline=True,
+        )
+        .join()
+    )
+    _assert_identical(resumed, unbounded_2pc4, min_evictions=1)
+    assert resumed.unique_state_count() == 1568
+    resumed.assert_properties()
 
 
 def test_budget_identical_2pc4_symmetry():
@@ -171,9 +240,11 @@ def test_checkpoint_mid_eviction_resume(tmp_path, unbounded_2pc4):
 
 
 @pytest.mark.slow
-def test_budget_identical_2pc5_acceptance():
+@pytest.mark.parametrize("async_on", [False, True])
+def test_budget_identical_2pc5_acceptance(async_on):
     """The acceptance run: 2pc-5 with the budget forcing >= 2 evictions,
-    bit-identical counts/discoveries/golden output to unbounded."""
+    bit-identical counts/discoveries/golden output to unbounded — on
+    the synchronous path and the async pipelined one."""
     metrics_registry().reset()
     budgeted = (
         TwoPhaseSys(5)
@@ -182,6 +253,7 @@ def test_budget_identical_2pc5_acceptance():
             frontier_capacity=16,
             table_capacity=1 << 14,
             hbm_budget_mib=tiny_budget(TwoPhaseSys(5), 16),
+            async_pipeline=async_on,
         )
         .join()
     )
@@ -198,10 +270,13 @@ def test_budget_identical_2pc5_acceptance():
 
 @pytest.mark.slow
 @pytest.mark.parametrize("fps", [True, False])
-def test_budget_identical_abd_expand_fps(fps):
-    """ABD register, fingerprint-only expansion on/off: the fps wave's
-    survivor path materializes only probed-fresh children; both pipelines
-    must stay bit-identical to their unbounded twins."""
+@pytest.mark.parametrize("async_on", [False, True])
+def test_budget_identical_abd_expand_fps(fps, async_on):
+    """ABD register, fingerprint-only expansion on/off × async pipeline
+    off/on: the fps wave's survivor path materializes only probed-fresh
+    children (in async mode that materialization runs on the pipeline
+    worker); every combination must stay bit-identical to its unbounded
+    synchronous twin."""
     from stateright_tpu.models.linearizable_register import AbdModelCfg
 
     def spawn(**kw):
@@ -220,18 +295,23 @@ def test_budget_identical_abd_expand_fps(fps):
 
     metrics_registry().reset()
     model = AbdModelCfg(2, 2).into_model()
-    budgeted = spawn(hbm_budget_mib=tiny_budget(model, 8))
+    budgeted = spawn(
+        hbm_budget_mib=tiny_budget(model, 8), async_pipeline=async_on
+    )
     unbounded = spawn()
     _assert_identical(budgeted, unbounded, min_evictions=2)
     assert budgeted.unique_state_count() == 544
 
 
 @pytest.mark.slow
-def test_sharded_budget_identical_with_spill(tmp_path):
+@pytest.mark.parametrize("async_on", [False, True])
+def test_sharded_budget_identical_with_spill(tmp_path, async_on):
     """Sharded checker: per-shard tiers, disk spill (L2) under a host
-    budget, and bit-identical results. The unbounded twin runs
-    wave-at-a-time too (the budgeted path forces it, and sharded deep
-    drains label depths at first-claim rather than minimal)."""
+    budget, and bit-identical results — synchronous and async-pipelined
+    (harvest verdicts on the worker, coalescing barrier when the pool
+    runs short). The unbounded twin runs wave-at-a-time too (the
+    budgeted path forces it, and sharded deep drains label depths at
+    first-claim rather than minimal)."""
     import jax
     import numpy as np
     from jax.sharding import Mesh
@@ -258,6 +338,7 @@ def test_sharded_budget_identical_with_spill(tmp_path):
         hbm_budget_mib=budget_for_table(rows),
         host_budget_mib=0.02,
         spill_dir=str(tmp_path),
+        async_pipeline=async_on,
     )
     unbounded = spawn(max_drain_waves=1)
     _assert_identical(
