@@ -233,6 +233,82 @@ def _leg_utilization(leg):
     return realized, predicted
 
 
+def service_trajectory(paths, out=sys.stdout):
+    """Concurrent-throughput trajectory across service bench records
+    (r10 time-sliced -> r12 tenant-packed): aggregate states/s, its
+    ratio to the single-job rate (the "concurrency tax"), ttfv
+    latencies, preempt counts, and lane fill where the record carries
+    pack accounting. Renders every file that holds a ``per_job``
+    record; exits nonzero when fewer than two do (nothing to compare)."""
+    sys.path.insert(
+        0, os.path.join(os.path.dirname(os.path.abspath(__file__)))
+    )
+    from service_report import load_record
+
+    rows = []
+    for path in paths:
+        rec = load_record(path)
+        if rec is None:
+            print(f"note: {path}: no service record", file=sys.stderr)
+            continue
+        agg = rec.get("aggregate_states_per_s")
+        steady = rec.get("aggregate_steady_states_per_s", agg)
+        single = rec.get("single_job_rate")
+        pack = rec.get("pack") or {}
+        rows.append(
+            {
+                "name": os.path.basename(path),
+                "mode": "packed" if rec.get("packed") else "sliced",
+                "jobs": rec.get("jobs"),
+                "aggregate": agg,
+                "steady": steady,
+                "ratio": (
+                    steady / single if steady and single else None
+                ),
+                "p50": rec.get("p50_ttfv_s"),
+                "p99": rec.get("p99_ttfv_s"),
+                "preempts": rec.get("preempts_total"),
+                "lane_fill": pack.get("lane_fill"),
+            }
+        )
+    if len(rows) < 2:
+        print(
+            "error: need >= 2 files with service records "
+            "(bench.py --service / --service-packed output)",
+            file=sys.stderr,
+        )
+        return 2
+    header = (
+        f"{'record':<16} {'mode':>7} {'jobs':>5} {'agg/s':>10} "
+        f"{'steady/s':>10} {'vs-1job':>8} {'p50ttfv':>8} {'p99ttfv':>8} "
+        f"{'preempts':>8} {'lanefill':>8}\n"
+    )
+    out.write(header)
+    out.write("-" * (len(header) - 1) + "\n")
+
+    def cell(v, spec="{:,.1f}"):
+        return "-" if v is None else spec.format(v)
+
+    for r in rows:
+        out.write(
+            f"{r['name']:<16} {r['mode']:>7} {str(r['jobs']):>5} "
+            f"{cell(r['aggregate']):>10} {cell(r['steady']):>10} "
+            f"{cell(r['ratio'], '{:.2f}x'):>8} "
+            f"{cell(r['p50'], '{:.2f}s'):>8} "
+            f"{cell(r['p99'], '{:.2f}s'):>8} "
+            f"{str(r['preempts']):>8} "
+            f"{cell(r['lane_fill'], '{:.2f}'):>8}\n"
+        )
+    first, last = rows[0], rows[-1]
+    if first["aggregate"] and last["aggregate"]:
+        out.write(
+            f"\nconcurrent aggregate {first['name']} -> {last['name']}: "
+            f"{first['aggregate']:,.1f} -> {last['aggregate']:,.1f} "
+            f"states/s ({last['aggregate'] / first['aggregate']:.2f}x)\n"
+        )
+    return 0
+
+
 def ab_async_report(path, out=sys.stdout):
     """The async-pipeline A/B table from one ``bench.py --async-ab``
     record (BENCH_r11+): rate and pipeline-utilization deltas between
@@ -324,7 +400,17 @@ def main(argv=None):
         help="render the async-pipeline A/B table (rate + predicted vs "
         "realized utilization) from one bench.py --async-ab record",
     )
+    parser.add_argument(
+        "--service-trajectory", action="store_true",
+        help="render the concurrent-throughput trajectory across "
+        "service bench records (time-sliced r10 vs tenant-packed r12+: "
+        "aggregate, ratio to single-job rate, ttfv, preempts, lane "
+        "fill)",
+    )
     args = parser.parse_args(argv)
+
+    if args.service_trajectory:
+        return service_trajectory(args.files)
 
     if args.ab_async:
         if len(args.files) != 1:

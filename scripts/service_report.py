@@ -55,6 +55,11 @@ def summarize(rec):
         "device": rec.get("device"),
         "jobs": rec.get("jobs", len(per_job)),
         "quantum_s": rec.get("quantum_s"),
+        # Tenant-packed records (BENCH_r12+): scheduling mode + the
+        # lane-occupancy evidence; absent/False on time-sliced records.
+        "packed": rec.get("packed", False),
+        "pack": rec.get("pack"),
+        "aggregate_vs_single_pct": rec.get("aggregate_vs_single_pct"),
         "batch_rate": rec.get("batch_rate"),
         "single_job_rate": rec.get("single_job_rate"),
         "service_overhead_pct": rec.get("service_overhead_pct"),
@@ -76,10 +81,11 @@ def _fmt(v, spec="{:,.1f}", none="-"):
 
 def render(summary, out=sys.stdout):
     w = out.write
+    mode = "tenant-packed" if summary.get("packed") else "time-sliced"
     w(
         f"service bench: {summary['jobs']} concurrent "
         f"{summary['model']} jobs on {summary['device']} "
-        f"(quantum {summary['quantum_s']}s)\n\n"
+        f"({mode}, quantum {summary['quantum_s']}s)\n\n"
     )
     w("  throughput (unique states/s)\n")
     w(f"    batch path        {_fmt(summary['batch_rate'])}\n")
@@ -87,11 +93,27 @@ def render(summary, out=sys.stdout):
         f"    service, 1 job    {_fmt(summary['single_job_rate'])}"
         f"  ({_fmt(summary['service_overhead_pct'], '{:+.1f}')}% overhead)\n"
     )
+    vs_single = ""
+    if summary.get("aggregate_vs_single_pct") is not None:
+        vs_single = (
+            f"  ({_fmt(summary['aggregate_vs_single_pct'], '{:+.1f}')}% "
+            "vs single job)"
+        )
     w(
         f"    service, {summary['jobs']} jobs   "
         f"{_fmt(summary['aggregate_states_per_s'])}  aggregate over "
-        f"{_fmt(summary['concurrent_wall_s'], '{:.1f}')}s\n\n"
+        f"{_fmt(summary['concurrent_wall_s'], '{:.1f}')}s{vs_single}\n\n"
     )
+    pack = summary.get("pack")
+    if pack:
+        w(
+            f"  packing: {pack.get('packed_jobs', '?')}/{summary['jobs']} "
+            f"jobs co-scheduled over {pack.get('waves', '?')} shared "
+            f"waves, lane fill "
+            f"{_fmt(pack.get('lane_fill'), '{:.2f}')} "
+            f"({pack.get('lanes_live', 0):,} live / "
+            f"{pack.get('lanes_dispatched', 0):,} dispatched)\n\n"
+        )
     w("  latency (submit -> first violation/witness)\n")
     w(f"    p50  {_fmt(summary['p50_ttfv_s'], '{:.3f}')}s\n")
     w(f"    p99  {_fmt(summary['p99_ttfv_s'], '{:.3f}')}s\n\n")
@@ -103,7 +125,7 @@ def render(summary, out=sys.stdout):
     header = (
         f"  {'job':<10} {'tenant':<10} {'ttfv_s':>8} {'wall_s':>8} "
         f"{'queued_s':>9} {'rate':>10} {'preempts':>8} {'slices':>6} "
-        f"{'compile_s':>9}\n"
+        f"{'packed':>6} {'compile_s':>9}\n"
     )
     w(header)
     w("  " + "-" * (len(header) - 3) + "\n")
@@ -115,6 +137,7 @@ def render(summary, out=sys.stdout):
             f"{_fmt(j.get('queued_s'), '{:.3f}'):>9} "
             f"{_fmt(j.get('rate')):>10} "
             f"{j.get('preempts', 0):>8} {j.get('slices', 0):>6} "
+            f"{str(bool(j.get('packed', False))):>6} "
             f"{_fmt(j.get('compile_s'), '{:.2f}'):>9}\n"
         )
 

@@ -85,6 +85,13 @@ class Checker(Generic[State, Action]):
 
     _preempt_payload = None
 
+    # Honest preemptibility surface (checking-as-a-service): True on the
+    # backends whose request_preempt() actually yields a resumable
+    # payload. The service exposes it per job so operators can SEE which
+    # jobs serialize the device instead of discovering it from a
+    # NotImplementedError at slice time.
+    supports_preempt = False
+
     def request_preempt(self) -> None:
         """Asks the worker to suspend at the next wave boundary and
         drain its state into an in-memory checkpoint payload. Device

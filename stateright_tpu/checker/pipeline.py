@@ -3,7 +3,11 @@
 In ``async_pipeline=True`` mode the device checkers dispatch wave N+1
 while wave N's host-tier work — the two-phase Bloom+run probe at the
 wave exit, L0→L1 eviction absorbs (and the LSM merges/spills they
-trigger), and checkpoint serialization — runs here. The design is a
+trigger), and checkpoint serialization — runs here. The tenant-packed
+engine (``checker/packed_tenancy.py``) rides the same worker for its
+per-tenant-partition probes, parent-log appends, and survivor re-entry:
+FIFO is the per-tenant merge fence there too, with the engine draining
+before evictions, lane drops, and admissions. The design is a
 two-deep pipeline (ScalaBFS-style channel pipelining, PAPERS.md): the
 device owns expansion/fingerprint/insert, this thread owns the tiered
 store's verdicts, and survivors of a deferred probe re-enter the

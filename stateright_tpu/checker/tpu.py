@@ -2804,6 +2804,8 @@ class TpuBfsChecker(Checker):
 
     # -- preemption (checking-as-a-service) --------------------------------
 
+    supports_preempt = True
+
     def request_preempt(self) -> None:
         """Asks the worker to suspend at the next wave/drain boundary:
         the run's full state (counters, parent map, pending frontier,

@@ -37,6 +37,8 @@ __all__ = [
     "pairs_acc",
     "acc_finalize",
     "multiset_row_pairs",
+    "tenant_salt_pair",
+    "salt_keys",
 ]
 
 _C1 = 0xCC9E2D51
@@ -283,6 +285,63 @@ def combine_pairs(his: jax.Array, los: jax.Array):
     """One (hi, lo) state fingerprint from C component-hash pairs (the
     direct form of the accumulator scheme — ``pairs_acc`` + finalize)."""
     return acc_finalize(pairs_acc(his, los), his.shape[0])
+
+
+# -- tenant salting (co-scheduled multi-tenancy; checker/packed_tenancy) ----
+#
+# Tenants packed into one shared visited table dedup on SALTED keys:
+# ``(hi ^ salt_hi, lo ^ salt_lo)``. XOR is the whole trick — it is a
+# bijection per tenant, so within a tenant two states collide salted iff
+# they collide unsalted (the packed run's dedup behavior is bit-identical
+# to the solo run's), while two tenants' keys relate through
+# ``salt_a ^ salt_b``, an avalanche-mixed 64-bit constant, so cross-tenant
+# aliasing is as (im)probable as any other 64-bit fingerprint collision.
+# Unsalting is the same XOR, so host-side structures (parent logs,
+# checkpoints, tiered-store partitions) always carry the tenant's ORIGINAL
+# fingerprints.
+
+
+def _fmix32_host(x: int) -> int:
+    """Host-side murmur3 fmix32 (mirrors ``_fmix`` bit for bit)."""
+    x &= 0xFFFFFFFF
+    x ^= x >> 16
+    x = (x * 0x85EBCA6B) & 0xFFFFFFFF
+    x ^= x >> 13
+    x = (x * 0xC2B2AE35) & 0xFFFFFFFF
+    return x ^ (x >> 16)
+
+
+def tenant_salt_pair(epoch: int):
+    """Deterministic (salt_hi, salt_lo) uint32 pair for tenant-salt epoch
+    ``epoch``. fmix32 is a bijection on u32, so distinct epochs give
+    distinct hi words — a re-admitted tenant under a fresh epoch can
+    never dedup against a departed tenant's leftover table keys. Epoch 0
+    is reserved for the identity salt (no-op: solo-compatible keys)."""
+    if epoch == 0:
+        return 0, 0
+    hi = _fmix32_host(0x9E3779B9 * (2 * epoch + 1))
+    lo = _fmix32_host(0x85EBCA6B * (2 * epoch + 3))
+    # The identity salt is reserved; an (astronomically unlikely) fmix
+    # collision with it just shifts to the neighbor epoch's mix.
+    if hi == 0 and lo == 0:
+        lo = 1
+    return hi, lo
+
+
+def salt_keys(hi: jax.Array, lo: jax.Array, salt_hi, salt_lo):
+    """Applies per-lane XOR salts to (hi, lo) key lanes and re-nudges the
+    reserved sentinels: (0, 0) is the hash-set empty slot and
+    (MAX, MAX) the checkers' invalid-lane sentinel — a salted key landing
+    on either must move off it (same nudge ``_finalize_pair`` applies to
+    raw fingerprints; the salt map stays injective everywhere else)."""
+    shi = hi ^ salt_hi
+    slo = lo ^ salt_lo
+    m = jnp.uint32(0xFFFFFFFF)
+    zero = (shi == 0) & (slo == 0)
+    slo = jnp.where(zero, jnp.uint32(1), slo)
+    maxed = (shi == m) & (slo == m)
+    slo = jnp.where(maxed, m - 1, slo)
+    return shi, slo
 
 
 def fp_to_int(hi, lo) -> int:

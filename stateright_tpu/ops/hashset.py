@@ -38,6 +38,7 @@ __all__ = [
     "hashset_new",
     "hashset_insert",
     "hashset_insert_unsorted",
+    "hashset_insert_salted",
     "hashset_contains",
     "hashset_probe_length_counts",
     "MAX_PROBES",
@@ -259,6 +260,36 @@ def hashset_insert_unsorted(
     found = found | falses.at[li].set(found2 & act2, mode="drop")
     pending_out = over | falses.at[li].set(pending2 & act2, mode="drop")
     return table, fresh, found, pending_out
+
+
+def hashset_insert_salted(
+    table: jax.Array,
+    key_hi: jax.Array,
+    key_lo: jax.Array,
+    salt_hi: jax.Array,
+    salt_lo: jax.Array,
+    active: jax.Array,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Tenant-salted visited-set insert for a packed multi-tenant wave
+    (``checker/packed_tenancy.py``): each lane's (hi, lo) fingerprint is
+    XOR-salted by its tenant's per-lane salt before claiming, so many
+    tenants share ONE table without cross-tenant dedup (see
+    ``ops.fingerprint.salt_keys`` for why XOR keeps every tenant's dedup
+    bit-identical to its solo run).
+
+    Built on the duplicate-tolerant UNSORTED insert on purpose: sorting
+    by salted key would interleave tenants' lanes in salt order, but the
+    owner-ticket scatter insert keeps natural lane order — so each
+    tenant's fresh lanes come out in its own FIFO frontier order, the
+    exact claim order its solo run (``wave_dedup="scatter"``, the CPU
+    backend default) produces. That order-preservation is what makes the
+    packed run's parent pointers, discovery fingerprints, and golden
+    reports per-tenant bit-identical, not just count-identical.
+    """
+    from .fingerprint import salt_keys
+
+    shi, slo = salt_keys(key_hi, key_lo, salt_hi, salt_lo)
+    return hashset_insert_unsorted(table, shi, slo, active)
 
 
 def hashset_probe_length_counts(table):

@@ -2429,6 +2429,8 @@ class ShardedTpuBfsChecker(Checker):
         # full path reconstruction discoveries() performs.
         return list(self._discoveries_fp)
 
+    supports_preempt = True
+
     def request_preempt(self) -> None:
         """Suspend at the next wave/drain boundary into an in-memory
         checkpoint payload (``preempt_payload()``); resume with

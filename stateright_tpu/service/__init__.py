@@ -11,10 +11,13 @@ Checker"). Three layers:
 
 - :class:`CheckService` — owns the device: an admission queue of
   :class:`CheckJob` s (model + options + per-tenant ``hbm_budget_mib`` /
-  deadline / priority) and a scheduler loop that time-slices the device
-  between active jobs at wave granularity, using the checkpoint-v2
-  machinery for preempt/resume (``TpuBfsChecker.request_preempt`` drains
-  a job's wave state to a host-side payload; resuming it later is
+  deadline / priority) and a scheduler loop. Qualifying same-shape jobs
+  are PACKED into shared physical waves (tenant-salted fingerprints in
+  one visited table, per-lane tenant ids — ``checker/packed_tenancy``),
+  so concurrency costs ~nothing and preemption is a lane drop; the rest
+  time-slice the device at wave granularity through the checkpoint-v2
+  preempt/resume machinery (``TpuBfsChecker.request_preempt`` drains a
+  job's wave state to a host-side payload; resuming it later is
   bit-identical to an uninterrupted run). ``submit()`` returns a
   :class:`JobHandle` (``result()`` / ``status()`` / ``cancel()``).
 - :class:`ServiceServer` — the HTTP front-end (``POST /jobs`` against

@@ -7,8 +7,15 @@ Extends the monitor/Explorer HTTP surface with the job API::
                                   "options": {...}, "spawn": {...},
                                   "priority": 0, "deadline_s": null,
                                   "tenant": "...", "hbm_budget_mib": null}
+                                 (an inadmissible hbm_budget_mib is a 400
+                                 at submit, not a mid-run failure)
     GET  /jobs                   every job's status (the UI panel feed)
-    GET  /jobs/<id>              one job: state, verdict, latency fields
+    GET  /jobs/<id>              one job: state, verdict, latency fields,
+                                 and the honest scheduling surface —
+                                 "packable" (+ "packable_reason"),
+                                 "preemptible" (false = this job
+                                 serializes the device), "packed" (it ran
+                                 co-scheduled in shared waves)
     POST /jobs/<id>/cancel       cancel (preempts a running job)
     GET  /jobs/<id>/metrics      that job's registry, Prometheus text,
                                  labeled {run_id="<id>"}

@@ -74,6 +74,21 @@ class CheckJob:
         self.error: Optional[str] = None
         self.preempts = 0
         self.slices = 0
+        # Honest backend surfacing (the service fills these at admission
+        # and corrects them from the live checker): ``preemptible`` —
+        # the spawn method yields resumable preempt payloads (a False
+        # here means this job SERIALIZES the device for its whole run);
+        # ``packable`` — the job qualifies for tenant-packed waves
+        # (``packable_reason`` says why not); ``packed`` — it actually
+        # ran co-scheduled in at least one pack.
+        self.preemptible: Optional[bool] = None
+        self.packable = False
+        self.packable_reason: Optional[str] = None
+        self.packed = False
+        # Budget-derived device table sizing (None = service default).
+        self.derived_table_capacity: Optional[int] = None
+        # Pack-membership clock: join time of the current packed slice.
+        self.pack_join_t: Optional[float] = None
         self.active_s = 0.0  # device-holding wall across slices
         self.warmup_s = 0.0  # summed compile warmup across incarnations
         self.submitted_t = clock()
@@ -180,6 +195,10 @@ class CheckJob:
                 "deadline_s": self.deadline_s,
                 "hbm_budget_mib": self.hbm_budget_mib,
                 "state": self.state,
+                "preemptible": self.preemptible,
+                "packable": self.packable,
+                "packable_reason": self.packable_reason,
+                "packed": self.packed,
                 "preempts": self.preempts,
                 "slices": self.slices,
                 "discoveries_so_far": sorted(self.seen_discoveries),

@@ -1,27 +1,44 @@
-"""The persistent check scheduler: one device, many jobs.
+"""The persistent check scheduler: one device, many jobs, packed waves.
 
 ``CheckService`` owns the accelerator the way a database owns its disk: a
 scheduler thread admits :class:`CheckJob` s (priority high-first, EDF
-within a priority, FIFO within a deadline) and time-slices the device
-between them at **wave granularity** — a running job is suspended by
-``TpuBfsChecker.request_preempt()`` (its wave state drains to a host-side
-checkpoint payload at the next wave/drain boundary) and resumed later by
-spawning a new checker with ``resume_from=<payload>``; the resumed run is
-bit-identical to an uninterrupted one (counts, depths, discoveries,
-golden reporter — tests/test_preempt_resume.py).
+within a priority, FIFO within a deadline) and multiplexes the device two
+ways:
+
+- **Tenant-packed waves (the default for qualifying jobs).** Same-shape
+  jobs — same zoo configuration, no spawn overrides, no symmetry/target
+  caps/budget — co-schedule onto ONE physical wave through
+  ``checker/packed_tenancy.TenantPackedEngine``: a shared visited table
+  under tenant-salted fingerprints, per-lane tenant ids, per-tenant
+  result reductions. Concurrency costs ~nothing (BENCH_r12 vs the
+  BENCH_r10 time-sliced baseline), admission is "claim a free lane
+  slot", late arrivals JOIN the live pack mid-run, and preemption is
+  "drop the tenant's lanes" — its survivors hand back as a checkpoint-v2
+  payload slice with no device drain. Every packed tenant's verdict is
+  bit-identical to its solo run (tests/test_packed_tenancy.py).
+- **Wave-granular time-slicing (the fallback).** Non-packable jobs are
+  suspended by ``request_preempt()`` (wave state drains to a host-side
+  checkpoint payload at the next wave/drain boundary) and resumed later
+  with ``resume_from=<payload>`` — bit-identical to an uninterrupted run
+  (tests/test_preempt_resume.py). Jobs whose backend cannot preempt at
+  all run their slice to completion; that fact is surfaced honestly as
+  ``preemptible: false`` in ``status()`` instead of being discovered
+  from a swallowed NotImplementedError.
 
 Jobs multiplex onto the shared AOT rung cache (``checker/tpu.py``'s
 ``shared_aot_cache``): two jobs of the same zoo configuration share every
-``(bucket, table_capacity)`` wave/drain executable, so the second job —
-and every preempted job's next incarnation — records zero compile phases
-in its attribution ledger. Each job runs under its own ``run_id``: its
-own metrics registry and run-stamped trace spans, so per-job ``/metrics``
-/ ``/status`` / SSE / attribution / coverage all work (PR 3-8 plumbing).
+``(bucket, table_capacity)`` wave/drain executable (the packed engine
+shares its wave/seed/rehash executables the same way), so the second job
+— and every preempted job's next incarnation — records zero compile
+phases. Each job runs under its own ``run_id``: its own metrics registry
+and run-stamped trace spans, so per-job ``/metrics`` / ``/status`` / SSE
+/ attribution / coverage all work, and packed jobs additionally carry
+their ``pack.tenant.*`` lane accounting (PR 3-8 + PR 12 plumbing).
 
-Single-device by design: slices are strictly serialized, so the device
-never has two claimants (the same constraint the bench's sentinel
-coordination enforces across processes, here enforced by the scheduler
-loop within one).
+Single-device by design: slices (packed or solo) are strictly
+serialized, so the device never has two claimants (the same constraint
+the bench's sentinel coordination enforces across processes, here
+enforced by the scheduler loop within one).
 """
 
 from __future__ import annotations
@@ -63,6 +80,11 @@ _DEFAULT_SPAWN = {
 # id is also the run_id, which keys process-global registries).
 _GLOBAL_JOB_SEQ = itertools.count()
 
+# Spawn methods whose checkers yield resumable preempt payloads
+# (``Checker.supports_preempt``). The admission-time guess; corrected
+# from the live checker after the first spawn.
+_PREEMPTIBLE_SPAWNS = frozenset({"spawn_tpu_bfs", "spawn_sharded_tpu_bfs"})
+
 
 class CheckService:
     """A long-lived, in-process checking service.
@@ -92,6 +114,9 @@ class CheckService:
         default_hbm_budget_mib: Optional[float] = None,
         spawn_method: str = "spawn_tpu_bfs",
         max_finished_jobs: int = 256,
+        packing: bool = True,
+        max_pack_tenants: int = 8,
+        pack_async: bool = False,
         clock=time.monotonic,
     ):
         self.quantum_s = float(quantum_s)
@@ -102,6 +127,20 @@ class CheckService:
             self.default_spawn.update(default_spawn)
         self.default_hbm_budget_mib = default_hbm_budget_mib
         self.spawn_method = spawn_method
+        # Tenant-packed waves (checker/packed_tenancy.py): qualifying
+        # same-shape jobs share one physical dispatch instead of
+        # time-slicing. ``packing=False`` restores the pure time-slicer;
+        # ``max_pack_tenants`` is the lane-slot count K;
+        # ``pack_async=True`` runs the pack's host half (per-tenant
+        # probes, parent logs, survivor re-entry) on a pipeline worker
+        # overlapped with the next dispatch.
+        self.packing = bool(packing)
+        self.max_pack_tenants = max(1, int(max_pack_tenants))
+        self.pack_async = bool(pack_async)
+        # Zoo-configuration model cache: one model instance per AOT
+        # namespace, shared by admission-time budget validation and the
+        # packed engines (models are pure packed-array containers).
+        self._pack_models: Dict[str, object] = {}
         # Retention: terminal jobs (and their run registries) beyond
         # this count are evicted oldest-first, so a long-lived service
         # does not accrete one registry + result blob per finished job
@@ -210,6 +249,22 @@ class CheckService:
             ) from None
         if hbm_budget_mib is None:
             hbm_budget_mib = self.default_hbm_budget_mib
+        # Budget-derived table sizing, validated AT ADMISSION: an
+        # over-budget request (the budget cannot fit even one worst-case
+        # wave of this model at the configured frontier) is rejected
+        # here with a clear error, not discovered as an OOM/ValueError
+        # on the scheduler thread mid-slice.
+        derived_table_capacity = None
+        if hbm_budget_mib is not None:
+            derived_table_capacity = self._validate_budget(
+                factory, aot_namespace, spawn, hbm_budget_mib
+            )
+        packable, packable_reason = self._classify_packable(
+            aot_namespace=aot_namespace,
+            options=options,
+            spawn=spawn,
+            hbm_budget_mib=hbm_budget_mib,
+        )
         with self._cond:
             seq = next(self._seq)
             # Default ids draw from the PROCESS-global sequence, not the
@@ -234,9 +289,105 @@ class CheckService:
                 seq=seq,
                 clock=self._clock,
             )
+            job.preemptible = self.spawn_method in _PREEMPTIBLE_SPAWNS
+            job.packable = packable
+            job.packable_reason = packable_reason
+            job.derived_table_capacity = derived_table_capacity
             self._jobs[jid] = job
             self._cond.notify_all()
         return JobHandle(job, self)
+
+    # -- admission policy ---------------------------------------------------
+
+    # Model-cache cap: a long-lived service fed many distinct zoo
+    # configurations must not pin a packed-array model instance per
+    # namespace forever (same retention rule as max_finished_jobs).
+    _PACK_MODEL_CACHE_MAX = 32
+
+    def _model_for(self, factory: Callable, aot_namespace: Optional[str]):
+        """The job's model instance — cached per AOT namespace (the
+        namespace asserts identical configuration, so one instance
+        serves budget validation and every pack under that key);
+        oldest-inserted entries evict past the cap."""
+        if aot_namespace is None:
+            return factory()
+        model = self._pack_models.get(aot_namespace)
+        if model is None:
+            model = factory()
+            self._pack_models[aot_namespace] = model
+            while len(self._pack_models) > self._PACK_MODEL_CACHE_MAX:
+                self._pack_models.pop(next(iter(self._pack_models)))
+        return model
+
+    def _validate_budget(
+        self, factory, aot_namespace, spawn, hbm_budget_mib
+    ) -> int:
+        """Derives the tenant's device table capacity from its
+        ``hbm_budget_mib`` (the budget IS the tenant's paid allocation —
+        the fixed ``_DEFAULT_SPAWN`` constant both over-allocated poor
+        tenants and growth-churned rich ones) and rejects inadmissible
+        budgets up front. Returns the capacity in rows."""
+        from ..checker.tpu import min_admissible_hbm_budget_mib
+        from ..storage import max_table_rows_for_budget
+
+        frontier = (spawn or {}).get(
+            "frontier_capacity",
+            self.default_spawn.get("frontier_capacity", 1 << 10),
+        )
+        model = self._model_for(factory, aot_namespace)
+        min_budget = min_admissible_hbm_budget_mib(model, frontier)
+        if hbm_budget_mib < min_budget:
+            raise ValueError(
+                f"hbm_budget_mib={hbm_budget_mib} rejected at admission: "
+                f"one worst-case wave at frontier_capacity={frontier} "
+                f"needs at least {min_budget:.3f} MiB for this model; "
+                "raise the budget or shrink frontier_capacity"
+            )
+        return max_table_rows_for_budget(hbm_budget_mib)
+
+    # default_spawn keys the packed engine either honors directly
+    # (frontier/table shape, async pipelining) or that cannot change
+    # packed semantics (max_drain_waves bounds SOLO preemption latency —
+    # the engine is wave-granular by construction; aot_cache names the
+    # SOLO executable namespace — packs use their own "pack:" one). Any
+    # other service-wide default (budgets, expand_fps, hashset_impl,
+    # checkpointing, ...) would be silently dropped by packing, so its
+    # presence honestly disqualifies packing instead.
+    _PACK_SAFE_DEFAULT_SPAWN = frozenset({
+        "frontier_capacity",
+        "table_capacity",
+        "max_drain_waves",
+        "aot_cache",
+        "async_pipeline",
+    })
+
+    def _classify_packable(self, *, aot_namespace, options, spawn,
+                           hbm_budget_mib):
+        """Whether a submission qualifies for tenant-packed waves, and
+        the honest reason when it does not (surfaced via ``status()`` so
+        operators can see which jobs serialize the device)."""
+        if not self.packing:
+            return False, "packing disabled on this service"
+        if self.spawn_method != "spawn_tpu_bfs":
+            return False, f"spawn_method {self.spawn_method!r}"
+        if aot_namespace is None:
+            return False, "custom model (no AOT namespace to pack under)"
+        if spawn:
+            return False, f"spawn overrides {sorted(spawn)}"
+        unsafe = set(self.default_spawn) - self._PACK_SAFE_DEFAULT_SPAWN
+        if unsafe:
+            return False, (
+                f"service default_spawn overrides {sorted(unsafe)} "
+                "(the packed engine cannot honor them)"
+            )
+        opts = options or {}
+        if opts.get("symmetry"):
+            return False, "symmetry reduction (orbit keys cannot salt)"
+        if opts.get("target_state_count"):
+            return False, "target_state_count (per-wave overshoot cap)"
+        if hbm_budget_mib is not None:
+            return False, "hbm_budget_mib (solo tiered run)"
+        return True, None
 
     # -- introspection ------------------------------------------------------
 
@@ -315,7 +466,10 @@ class CheckService:
                 if self._closing.is_set():
                     return
             try:
-                self._run_slice(job)
+                if self.packing and job.packable:
+                    self._run_packed_slice(job)
+                else:
+                    self._run_slice(job)
             except Exception as e:  # noqa: BLE001 - a job must not kill the loop
                 job.fail(repr(e))
             self._evict_finished()
@@ -332,6 +486,13 @@ class CheckService:
             builder = builder.symmetry()
         spawn = dict(self.default_spawn)
         spawn.update(job.spawn)
+        if (
+            job.derived_table_capacity is not None
+            and "table_capacity" not in job.spawn
+        ):
+            # The tenant's budget, not the fixed default, sizes its
+            # device table (see _validate_budget).
+            spawn["table_capacity"] = job.derived_table_capacity
         spawn["run_id"] = job.run_id
         # Cross-job executable sharing is a single-device-checker
         # feature for now (the sharded checker has no aot_cache knob);
@@ -347,7 +508,20 @@ class CheckService:
         if job.payload is not None:
             spawn["resume_from"] = job.payload
             job.payload = None
-        return getattr(builder, self.spawn_method)(**spawn)
+        method = getattr(builder, self.spawn_method)
+        import inspect
+
+        sig = inspect.signature(method)
+        if not any(
+            p.kind is p.VAR_KEYWORD for p in sig.parameters.values()
+        ):
+            # Host-engine spawn methods (spawn_bfs/dfs/...) take no
+            # kwargs: drop the device-spawn defaults (run_id included —
+            # their metrics land in the default registry) so the
+            # degrade-gracefully branch below is actually reachable
+            # instead of dying on a TypeError at spawn.
+            spawn = {k: v for k, v in spawn.items() if k in sig.parameters}
+        return method(**spawn)
 
     def _poll_discoveries(self, job: CheckJob, checker) -> None:
         try:
@@ -376,6 +550,9 @@ class CheckService:
             job.fail(repr(e))
             return
         self._active_checker = checker
+        # Honest preemptibility: the admission-time guess (spawn-method
+        # map) corrected from the live checker's own declaration.
+        job.preemptible = bool(getattr(checker, "supports_preempt", False))
         # On resume, the restored discoveries must not count as "first".
         self._poll_discoveries(job, checker)
         slice_end = t0 + self.quantum_s
@@ -390,6 +567,7 @@ class CheckService:
                 checker.request_preempt()
                 return True
             except NotImplementedError:
+                job.preemptible = False
                 return False
 
         preempting = False
@@ -427,6 +605,215 @@ class CheckService:
             job.suspend(checker.preempt_payload())
             return
         job.complete(self._finalize(job, checker))
+
+    # -- the packer (tenant-packed waves) -----------------------------------
+
+    def _pack_peers(self, key: str, members: Dict[str, CheckJob]):
+        """Runnable packable same-configuration jobs not yet in the pack
+        — the admission candidates, best-first."""
+        with self._cond:
+            peers = [
+                j
+                for j in self._jobs.values()
+                if j.job_id not in members
+                and j.runnable()
+                and not j.cancel_event.is_set()
+                and j.packable
+                and j.aot_namespace == key
+            ]
+        return sorted(peers, key=lambda j: j.sort_key())
+
+    def _pack_contender(self, key: str, members: Dict[str, CheckJob],
+                        can_join: bool) -> bool:
+        """Whether a runnable job OUTSIDE the pack — one that cannot
+        simply join it — sorts ahead of where the pack's best member
+        would re-enter the queue. Same honesty rule as
+        ``_should_preempt_for_peer``: suspending the pack must actually
+        hand the device to someone else. A same-shape packable job
+        counts as a contender too once the pack has no free lane
+        (``can_join=False``) — otherwise a full pack would starve a
+        higher-priority same-shape arrival that the time-slicer would
+        have preempted for."""
+        now = self._clock()
+        reentry = min(
+            j.sort_key(last_run_override=now) for j in members.values()
+        )
+        with self._cond:
+            return any(
+                j.job_id not in members
+                and j.runnable()
+                and not j.cancel_event.is_set()
+                and not (
+                    can_join and j.packable and j.aot_namespace == key
+                )
+                and j.sort_key() < reentry
+                for j in self._jobs.values()
+            )
+
+    def _pack_admit(self, engine, job: CheckJob):
+        """Claims a lane slot for one job (restoring its suspended
+        payload slice, if any); stamps the membership clocks only AFTER
+        the admission succeeds — a failed admit must not leave the job
+        reporting packed:true with a counted slice."""
+        view = engine.admit(
+            job.job_id,
+            job.run_id,
+            depth_cap=job.options.get("target_max_depth"),
+            resume_from=job.payload,
+        )
+        job.payload = None
+        job.state = JOB_RUNNING
+        job.slices += 1
+        job.packed = True
+        now = self._clock()
+        if job.started_t is None:
+            job.started_t = now
+        job.pack_join_t = now
+        # Restored discoveries must not count as "first" for ttfv.
+        try:
+            job.seen_discoveries |= set(view._discovery_names())
+        except Exception:  # noqa: BLE001 - best effort
+            pass
+        return view
+
+    def _try_pack_admit(self, engine, job, members, views) -> bool:
+        try:
+            view = self._pack_admit(engine, job)
+        except Exception as e:  # noqa: BLE001 - bad knobs = job failure
+            job.fail(repr(e))
+            return False
+        members[job.job_id] = job
+        views[job.job_id] = view
+        return True
+
+    def _pack_leave(self, job: CheckJob, view) -> None:
+        """Membership clocks on any exit (complete/suspend/cancel)."""
+        now = self._clock()
+        job.active_s += now - (job.pack_join_t or now)
+        job.pack_join_t = None
+        job.last_run_t = now
+        job.warmup_s += getattr(view, "warmup_seconds", None) or 0.0
+
+    def _suspend_pack(self, engine, members, views) -> None:
+        """Drops every member's lanes (no device drain): each hands back
+        its survivors as a checkpoint-v2 payload slice and re-enters the
+        admission queue suspended."""
+        for jid, job in list(members.items()):
+            # A cancelled member's payload would be thrown away —
+            # discard up front instead of building the full parent-map
+            # export on the scheduler thread.
+            cancelled = job.cancel_event.is_set()
+            payload = engine.drop(jid, discard=cancelled)
+            self._pack_leave(job, views[jid])
+            if cancelled:
+                job.payload = None
+                job.finish(JOB_CANCELLED)
+            else:
+                job.suspend(payload)
+        members.clear()
+        views.clear()
+
+    def _run_packed_slice(self, lead: CheckJob) -> None:
+        """One packed slice: every runnable same-configuration packable
+        job co-schedules onto one ``TenantPackedEngine`` — shared waves,
+        per-tenant lane accounting. Late same-shape arrivals JOIN the
+        live pack (admission = claim a free lane slot); a member's
+        cancel drops only its lanes; quantum expiry suspends the pack
+        only when an outside contender would actually be picked.
+        Strictly serialized with every other slice — the device still
+        has exactly one claimant."""
+        from ..checker.packed_tenancy import TenantPackedEngine
+
+        key = lead.aot_namespace
+        spawn = dict(self.default_spawn)
+        model = self._model_for(lead.model_factory, key)
+        founders = [lead, *self._pack_peers(key, {})]
+        base_table = spawn.get("table_capacity", 1 << 16)
+        # Size the shared table for the founding fleet up front: K
+        # tenants' visited sets share one table, and pre-sizing avoids
+        # the growth rehashes (and their per-shape compiles) a
+        # per-tenant-sized table would churn through mid-pack.
+        m = 1
+        while m < min(len(founders), self.max_pack_tenants):
+            m *= 2
+        engine = TenantPackedEngine(
+            model,
+            frontier_capacity=spawn.get("frontier_capacity", 1 << 10),
+            table_capacity=base_table * m,
+            max_tenants=self.max_pack_tenants,
+            # Packed waves are occupancy-dense by construction (that is
+            # the point of packing) — the bucket ladder would only buy
+            # a compile shape per rung for the few ramp-up waves.
+            bucket_ladder=0,
+            aot_cache=f"pack:{key}",
+            resume_capacity=base_table,
+            # The service knob, or a service-wide async default (a
+            # pack-safe default_spawn key) — either opts the pack's
+            # host half onto the pipeline worker.
+            async_pipeline=(
+                self.pack_async
+                or bool(spawn.get("async_pipeline"))
+            ),
+        )
+        members: Dict[str, CheckJob] = {}
+        views: Dict[str, object] = {}
+        self._active_checker = engine
+        slice_end = self._clock() + self.quantum_s
+        try:
+            for job in founders:
+                if engine.free_slots() == 0:
+                    break
+                if job.job_id not in members:
+                    self._try_pack_admit(engine, job, members, views)
+            while members and engine.live_count():
+                if self._closing.is_set():
+                    self._suspend_pack(engine, members, views)
+                    return
+                for jid, job in list(members.items()):
+                    if job.cancel_event.is_set():
+                        engine.drop(jid, discard=True)
+                        self._pack_leave(job, views.pop(jid))
+                        members.pop(jid)
+                        job.payload = None
+                        job.finish(JOB_CANCELLED)
+                if not members:
+                    return
+                if engine.free_slots():
+                    for job in self._pack_peers(key, members):
+                        if engine.free_slots() == 0:
+                            break
+                        self._try_pack_admit(engine, job, members, views)
+                if (
+                    self._clock() >= slice_end
+                    and self._pack_contender(
+                        key, members, engine.free_slots() > 0
+                    )
+                ):
+                    self._suspend_pack(engine, members, views)
+                    return
+                for done_key in engine.step():
+                    job = members.pop(done_key)
+                    view = views.pop(done_key)
+                    # Final discovery sweep BEFORE completing: a
+                    # discovery landing in the job's last wave must
+                    # still stamp first_discovery_t (ttfv) — the solo
+                    # path polls once more after join for the same
+                    # reason.
+                    self._poll_discoveries(job, view)
+                    self._pack_leave(job, view)
+                    engine.release(done_key)
+                    job.complete(self._finalize(job, view))
+                for jid, job in members.items():
+                    self._poll_discoveries(job, views[jid])
+        except Exception as e:  # noqa: BLE001 - engine failure fails members
+            if not members:
+                raise
+            err = repr(e)
+            for job in members.values():
+                job.fail(err)
+        finally:
+            self._active_checker = None
+            engine.close()
 
     def _evict_finished(self) -> None:
         """Drops the oldest terminal jobs (and their run registries)

@@ -30,6 +30,7 @@ from .bloom import BloomFilter
 from .runs import RUN_BLOCK, FingerprintRun, decode_varint_u64, encode_varint_u64
 from .tiered import (
     StorageInstruments,
+    TenantPartitions,
     TieredVisitedStore,
     max_table_rows_for_budget,
     validate_budget_knobs,
@@ -40,6 +41,7 @@ __all__ = [
     "FingerprintRun",
     "RUN_BLOCK",
     "StorageInstruments",
+    "TenantPartitions",
     "TieredVisitedStore",
     "decode_varint_u64",
     "encode_varint_u64",

@@ -43,6 +43,7 @@ from .runs import FingerprintRun
 __all__ = [
     "StorageInstruments",
     "TieredVisitedStore",
+    "TenantPartitions",
     "max_table_rows_for_budget",
     "validate_budget_knobs",
 ]
@@ -433,3 +434,75 @@ class TieredVisitedStore:
                 l2 = [self._spill_run(r) for r in l2]
             self.l2 = l2
         self._instr.refresh()
+
+
+class TenantPartitions:
+    """Per-tenant host-tier partitions for the tenant-packed wave engine
+    (``checker/packed_tenancy.py``).
+
+    The packed engine's shared device table holds SALTED keys, which
+    cannot be attributed to a tenant after the fact — so the host tiers
+    are partitioned up front: each tenant gets its own
+    ``TieredVisitedStore`` holding its ORIGINAL (unsalted) fingerprints.
+    An eviction drains each tenant's since-last-eviction L0 claims (the
+    engine knows them exactly — they are its parent-log stream) into that
+    tenant's partition, and each wave's two-phase probe runs per tenant
+    against its own partition. A tenant's partition is therefore
+    membership-equivalent to the solo run's tiered store, its export
+    rides the tenant's preempt payload slice unchanged, and dropping a
+    tenant frees its tiers without touching anyone else's.
+
+    Same threading contract as ``TieredVisitedStore``: under the async
+    packed pipeline every probe/evict runs on the one pipeline worker in
+    FIFO order (the merge fence); the per-store locks remain as the
+    second fence for cross-thread snapshot readers.
+    """
+
+    def __init__(
+        self,
+        host_budget_mib=None,
+        spill_dir=None,
+        prefix: str = "pack",
+        tracer=None,
+    ):
+        self._host_budget_mib = host_budget_mib
+        self._spill_dir = spill_dir
+        self._prefix = prefix
+        self._tracer = tracer
+        self._stores: dict = {}
+
+    def store(self, tenant_key, registry=None) -> TieredVisitedStore:
+        """The tenant's partition, created on first use. ``registry`` (the
+        tenant's run-scoped metrics registry) binds the partition's
+        storage instruments to that tenant's ``/metrics`` view."""
+        st = self._stores.get(tenant_key)
+        if st is None:
+            spill = self._spill_dir
+            if spill is not None:
+                spill = os.path.join(spill, f"tenant-{tenant_key}")
+                os.makedirs(spill, exist_ok=True)
+            st = TieredVisitedStore(
+                host_budget_mib=self._host_budget_mib,
+                spill_dir=spill,
+                instruments=StorageInstruments(
+                    self._prefix, registry=registry
+                ),
+                tracer=self._tracer,
+            )
+            self._stores[tenant_key] = st
+        return st
+
+    def get(self, tenant_key):
+        """The tenant's partition, or None (never probed/evicted)."""
+        return self._stores.get(tenant_key)
+
+    def drop(self, tenant_key) -> None:
+        """Forgets a departed tenant's partition (its runs free with it)."""
+        self._stores.pop(tenant_key, None)
+
+    def is_empty(self, tenant_key) -> bool:
+        st = self._stores.get(tenant_key)
+        return st is None or st.is_empty()
+
+    def items(self):
+        return list(self._stores.items())

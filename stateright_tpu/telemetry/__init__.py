@@ -23,7 +23,7 @@ simulation, the TPU wave/drain loops, and the sharded mesh checker).
 
 from .attribution import WaveAttribution
 from .coverage import CoverageLedger, DeviceCoverage
-from .instruments import BlockInstruments, WaveInstruments
+from .instruments import BlockInstruments, TenantInstruments, WaveInstruments
 from .metrics import (
     Counter,
     Gauge,
@@ -88,6 +88,7 @@ __all__ = [
     "ProgressEstimator",
     "RunScopedTracer",
     "StallWatchdog",
+    "TenantInstruments",
     "Tracer",
     "WaveAttribution",
     "WaveInstruments",

@@ -137,3 +137,39 @@ class BlockInstruments:
             unique_total=unique_total,
             **extra,
         ).__exit__(None, None, None)
+
+
+class TenantInstruments:
+    """Per-tenant counters/gauges for the tenant-packed wave engine
+    (``checker/packed_tenancy.py``), named ``<prefix>.tenant.*`` and
+    recorded into the TENANT'S run-scoped registry — so a packed job's
+    ``GET /jobs/<id>/metrics`` view carries its own lane accounting even
+    though the physical waves are shared. One bundle per admitted tenant;
+    the engine-wide (shared-wave) quantities ride a ``WaveInstruments``
+    bundle under the engine's own registry."""
+
+    def __init__(self, prefix: str, registry: MetricsRegistry = None):
+        reg = registry if registry is not None else metrics_registry()
+        p = f"{prefix}.tenant"
+        self.joins = reg.counter(f"{p}.joins")
+        self.waves = reg.counter(f"{p}.waves")
+        self.lanes = reg.counter(f"{p}.lanes_dispatched")
+        self.generated = reg.counter(f"{p}.states_generated")
+        self.unique = reg.counter(f"{p}.states_unique")
+        self.stale = reg.counter(f"{p}.storage_stale")
+        self.lane_drops = reg.counter(f"{p}.preempt_lane_drops")
+        self.lane_share = reg.gauge(f"{p}.lane_share")
+        self.pending = reg.gauge(f"{p}.pending_lanes")
+        self.depth = reg.gauge(f"{p}.max_depth")
+
+    def record_wave(self, *, lanes: int, width: int, generated: int,
+                    n_new: int, pending: int, max_depth: int) -> None:
+        """One packed wave's slice of this tenant's accounting (only
+        called for waves the tenant had lanes in)."""
+        self.waves.inc()
+        self.lanes.inc(lanes)
+        self.generated.inc(generated)
+        self.unique.inc(n_new)
+        self.lane_share.set(lanes / width if width else 0.0)
+        self.pending.set(pending)
+        self.depth.set(max_depth)

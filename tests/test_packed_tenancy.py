@@ -1,0 +1,488 @@
+"""Tenant-packed waves (PR 12): co-scheduled multi-tenant dispatch.
+
+The contract under test: each packed tenant's results — counts, depths,
+discovery fingerprints, golden reporter — are BIT-IDENTICAL to its solo
+``spawn_tpu_bfs`` run. The argument (checker/packed_tenancy.py): XOR
+salting preserves within-tenant dedup exactly, and the owner-ticket
+scatter insert preserves per-tenant FIFO lane order, so a tenant's claim
+sequence is candidate-order-equivalent to its solo run under the CPU
+backend's default ``wave_dedup="scatter"``.
+
+Fast lane: 2pc-3 packs (pair, mid-run join, lane-drop preempt → resume
+into a later pack / a solo checker, async pipeline, out-of-core
+per-tenant partitions), service-level packing (co-scheduled jobs with
+zero preempts, mid-run join, honest packable/preemptible surfacing,
+budget admission), and the ``pack.tenant.*`` registry hygiene gate.
+Slow lane: ABD (fps-capable model, materializing solo twin).
+
+Shapes reuse the suite's standard 2pc spawn (frontier 16 / table 4096)
+so the persistent compile cache keeps these cheap; one shared AOT
+namespace per engine configuration means incarnations never re-trace.
+"""
+
+import io
+import re
+import time
+
+import pytest
+
+from stateright_tpu import WriteReporter
+from stateright_tpu.checker.packed_tenancy import TenantPackedEngine
+from stateright_tpu.models.two_phase_commit import TwoPhaseSys
+from stateright_tpu.service import CheckService
+from stateright_tpu.telemetry import metrics_registry
+
+ENGINE_KW = dict(
+    frontier_capacity=16, table_capacity=1 << 12, max_tenants=4,
+    aot_cache="t-pack",
+)
+UNIQUE_2PC3 = 288
+UNIQUE_2PC4 = 1568
+
+
+def _golden(checker_or_text):
+    if isinstance(checker_or_text, str):
+        text = checker_or_text
+    else:
+        out = io.StringIO()
+        checker_or_text.report(WriteReporter(out))
+        text = out.getvalue()
+    return re.sub(r"sec=\d+", "sec=_", text)
+
+
+@pytest.fixture(scope="module")
+def solo_2pc3():
+    """The solo reference run every packed tenant is compared against
+    (scatter dedup — the CPU backend default — is what packing's
+    order-equivalence argument targets)."""
+    return (
+        TwoPhaseSys(3)
+        .checker()
+        .spawn_tpu_bfs(frontier_capacity=16, table_capacity=1 << 12)
+        .join()
+    )
+
+
+def _drive(engine, max_steps=20_000):
+    """Runs the engine to quiescence; returns {key: view} of finished
+    tenants (slots released as they finish)."""
+    views = {}
+    steps = 0
+    while engine.live_count():
+        for key in engine.step():
+            views[key] = engine.view(key)
+            engine.release(key)
+        steps += 1
+        assert steps < max_steps, "packed engine did not converge"
+    return views
+
+
+def _assert_matches_solo(view, solo):
+    assert view.unique_state_count() == solo.unique_state_count()
+    assert view.state_count() == solo.state_count()
+    assert view.max_depth() == solo.max_depth()
+    assert set(view._discovery_names()) == set(solo._discovery_names())
+    # Golden report includes the reconstructed discovery PATHS, so this
+    # is discovery-fingerprint- and parent-pointer-exact.
+    assert _golden(view) == _golden(solo)
+
+
+# -- engine-level bit-identity ------------------------------------------------
+
+
+def test_packed_pair_bit_identical_vs_solo(solo_2pc3):
+    """Two tenants of one shared wave each reproduce the solo run
+    exactly — counts, depths, discoveries, golden reporter."""
+    engine = TenantPackedEngine(TwoPhaseSys(3), **ENGINE_KW)
+    a = engine.admit("a", "pk-a")
+    b = engine.admit("b", "pk-b")
+    _drive(engine)
+    engine.close()
+    _assert_matches_solo(a, solo_2pc3)
+    _assert_matches_solo(b, solo_2pc3)
+
+
+def test_tenant_join_mid_run(solo_2pc3):
+    """Admission claims a free lane slot in a LIVE pack: the late tenant
+    starts from its own seed mid-flight and still matches solo."""
+    engine = TenantPackedEngine(TwoPhaseSys(3), **ENGINE_KW)
+    early = engine.admit("early", "pk-early")
+    for _ in range(5):
+        engine.step()
+    late = engine.admit("late", "pk-late")
+    _drive(engine)
+    engine.close()
+    _assert_matches_solo(early, solo_2pc3)
+    _assert_matches_solo(late, solo_2pc3)
+
+
+def test_lane_drop_preempt_resumes_into_later_pack(solo_2pc3):
+    """Preempting a packed tenant drops its lanes — no device drain —
+    and its checkpoint-v2 payload slice resumes into a LATER pack
+    (alongside a fresh tenant) bit-identically."""
+    engine = TenantPackedEngine(TwoPhaseSys(3), **ENGINE_KW)
+    engine.admit("victim", "pk-v1")
+    engine.admit("peer", "pk-p1")
+    for _ in range(6):
+        engine.step()
+    payload = engine.drop("victim")
+    assert payload is not None and payload["kind"] == "tpu_bfs"
+    assert engine.view("victim") is None  # slot freed
+    assert engine.free_slots() == 3
+    peer_views = _drive(engine)
+    engine.close()
+    _assert_matches_solo(
+        peer_views.get("peer") or engine.view("peer"), solo_2pc3
+    )
+
+    later = TenantPackedEngine(TwoPhaseSys(3), **ENGINE_KW)
+    resumed = later.admit("victim", "pk-v2", resume_from=payload)
+    fresh = later.admit("fresh", "pk-f2")
+    _drive(later)
+    later.close()
+    _assert_matches_solo(resumed, solo_2pc3)
+    _assert_matches_solo(fresh, solo_2pc3)
+
+
+def test_lane_drop_payload_resumes_solo(solo_2pc3):
+    """The payload slice is a STANDARD checkpoint-v2 payload: a dropped
+    tenant resumes on a plain ``TpuBfsChecker`` bit-identically (the
+    cross-path escape hatch — packed jobs are never locked in)."""
+    engine = TenantPackedEngine(TwoPhaseSys(3), **ENGINE_KW)
+    engine.admit("solo-bound", "pk-sb")
+    for _ in range(4):
+        engine.step()
+    payload = engine.drop("solo-bound")
+    engine.close()
+    resumed = (
+        TwoPhaseSys(3)
+        .checker()
+        .spawn_tpu_bfs(
+            frontier_capacity=16, table_capacity=1 << 12,
+            resume_from=payload,
+        )
+        .join()
+    )
+    _assert_matches_solo(resumed, solo_2pc3)
+
+
+def test_packed_async_pipeline(solo_2pc3):
+    """``async_pipeline=True``: per-tenant probes, parent logs, and
+    survivor re-entry ride the FIFO host worker overlapped with the
+    next dispatch — results unchanged."""
+    engine = TenantPackedEngine(
+        TwoPhaseSys(3), async_pipeline=True, **ENGINE_KW
+    )
+    a = engine.admit("as-a", "pk-as-a")
+    b = engine.admit("as-b", "pk-as-b")
+    _drive(engine)
+    engine.close()
+    _assert_matches_solo(a, solo_2pc3)
+    _assert_matches_solo(b, solo_2pc3)
+
+
+def test_packed_out_of_core_partitions(solo_2pc3):
+    """A budget-capped shared table evicts into PER-TENANT partitions
+    (each tenant's since-eviction claims drain into its own run set);
+    results stay exact and the stale-probe accounting lands in each
+    tenant's own registry."""
+    from stateright_tpu.checker.tpu import min_admissible_hbm_budget_mib
+
+    budget = min_admissible_hbm_budget_mib(TwoPhaseSys(3), 16) * 2
+    kw = dict(ENGINE_KW)
+    kw["aot_cache"] = "t-pack-oc"
+    engine = TenantPackedEngine(
+        TwoPhaseSys(3), hbm_budget_mib=budget, **kw
+    )
+    a = engine.admit("oc-a", "pk-oc-a")
+    b = engine.admit("oc-b", "pk-oc-b")
+    _drive(engine)
+    engine.close()
+    _assert_matches_solo(a, solo_2pc3)
+    _assert_matches_solo(b, solo_2pc3)
+    snap = metrics_registry("pk-oc-a").snapshot()
+    assert snap.get("pack.tenant.storage_stale", 0) > 0, (
+        "the budget never bound (no per-tenant host probes happened)"
+    )
+
+
+def test_resume_admission_under_budget_pressure(solo_2pc3):
+    """Review regression: a budget eviction fired by the ADMISSION's own
+    bulk key claims must flush the joining tenant's restored keys into
+    its partition (the tenant registers before restoring). Without
+    that, a resumed payload bigger than the budget-capped table would
+    silently lose its earlier-batch visited keys and re-count them."""
+    from stateright_tpu.checker.tpu import min_admissible_hbm_budget_mib
+
+    donor = TenantPackedEngine(TwoPhaseSys(3), **ENGINE_KW)
+    donor.admit("big", "pk-big")
+    steps = 0
+    while donor.view("big").unique_state_count() < 250:
+        donor.step()
+        steps += 1
+        assert steps < 20_000
+    payload = donor.drop("big")
+    donor.close()
+    assert len(payload["children"]) >= 250
+
+    kw = dict(ENGINE_KW)
+    kw["aot_cache"] = "t-pack-oc"
+    tight = TenantPackedEngine(
+        TwoPhaseSys(3),
+        hbm_budget_mib=min_admissible_hbm_budget_mib(TwoPhaseSys(3), 16),
+        **kw,
+    )
+    # White-box: the tenant must be REGISTERED before its restore runs
+    # (so an eviction fired by the admission's own claims flushes its
+    # resident keys) — the load needed to force that eviction mid-loop
+    # is not deterministic, so pin the ordering directly.
+    orig_restore = tight._restore_tenant
+    seen = {}
+
+    def spy(t, pl):
+        seen["registered"] = tight._by_key.get("big") is t
+        return orig_restore(t, pl)
+
+    tight._restore_tenant = spy
+    resumed = tight.admit("big", "pk-big2", resume_from=payload)
+    assert seen["registered"] is True
+    _drive(tight)
+    tight.close()
+    _assert_matches_solo(resumed, solo_2pc3)
+
+    # A FAILED admission must deregister cleanly (free slot, no ghost).
+    bad = dict(payload)
+    bad["fp_scheme"] = "not-a-scheme"
+    eng = TenantPackedEngine(TwoPhaseSys(3), **ENGINE_KW)
+    with pytest.raises(ValueError, match="fingerprint scheme"):
+        eng.admit("ghost", "pk-ghost", resume_from=bad)
+    assert eng.view("ghost") is None
+    assert eng.free_slots() == 4
+    eng.close()
+
+
+@pytest.mark.slow
+def test_packed_abd_bit_identical_vs_solo():
+    """ABD (an fps-capable actor model): packed tenants match the solo
+    materializing run exactly. (The solo fps pipeline is itself
+    bit-identical to materializing — tests/test_expand_fps.py — so this
+    pins the packed path to both.)"""
+    from stateright_tpu.models.linearizable_register import AbdModelCfg
+
+    solo = (
+        AbdModelCfg(2, 2)
+        .into_model()
+        .checker()
+        .spawn_tpu_bfs(
+            frontier_capacity=8, table_capacity=1 << 12,
+            expand_fps=False,
+        )
+        .join()
+    )
+    engine = TenantPackedEngine(
+        AbdModelCfg(2, 2).into_model(),
+        frontier_capacity=8, table_capacity=1 << 12, max_tenants=2,
+        aot_cache="t-pack-abd",
+    )
+    a = engine.admit("abd-a", "pk-abd-a")
+    b = engine.admit("abd-b", "pk-abd-b")
+    _drive(engine, max_steps=200_000)
+    engine.close()
+    _assert_matches_solo(a, solo)
+    _assert_matches_solo(b, solo)
+
+
+# -- service-level packing ----------------------------------------------------
+
+SPAWN_2PC = {
+    "frontier_capacity": 16,
+    "table_capacity": 1 << 12,
+    "max_drain_waves": 2,
+    "aot_cache": "t-pack-svc",
+}
+
+
+@pytest.fixture
+def service():
+    svc = CheckService(quantum_s=0.75, default_spawn=dict(SPAWN_2PC))
+    yield svc
+    svc.close()
+
+
+def test_service_packs_same_shape_jobs(service):
+    """The scheduler co-schedules same-configuration jobs into one
+    pack: both complete exactly, in ONE slice each, with ZERO preempts
+    — concurrency without the r10 drain/restore churn — and the packed/
+    packable/preemptible facts are surfaced in status()."""
+    h1 = service.submit(model_name="2pc", model_args={"rm_count": 3})
+    h2 = service.submit(model_name="2pc", model_args={"rm_count": 3})
+    r1 = h1.result(timeout=180)
+    r2 = h2.result(timeout=180)
+    assert r1["unique"] == r2["unique"] == UNIQUE_2PC3
+    assert _golden(r1["report"]) == _golden(r2["report"])
+    for h in (h1, h2):
+        st = h.status()
+        assert st["packed"] is True
+        assert st["packable"] is True and st["packable_reason"] is None
+        assert st["preemptible"] is True
+        assert st["preempts"] == 0
+        assert st["slices"] == 1
+        assert st["latency"]["ttfv_s"] is not None
+    # Per-tenant lane accounting landed in each job's own registry.
+    snap = metrics_registry(h1.job_id).snapshot()
+    assert snap.get("pack.tenant.states_unique", 0) + 1 >= UNIQUE_2PC3
+    assert snap.get("pack.tenant.joins") == 1
+
+
+def test_service_join_live_pack(service):
+    """A same-shape job submitted while a pack is RUNNING joins it
+    mid-flight (admission = claim a free lane) instead of waiting for
+    the device."""
+    h1 = service.submit(model_name="2pc", model_args={"rm_count": 4})
+    deadline = time.monotonic() + 60
+    while (
+        service.job(h1.job_id).state == "queued"
+        and time.monotonic() < deadline
+    ):
+        time.sleep(0.002)
+    h2 = service.submit(model_name="2pc", model_args={"rm_count": 4})
+    r1 = h1.result(timeout=300)
+    r2 = h2.result(timeout=300)
+    assert r1["unique"] == r2["unique"] == UNIQUE_2PC4
+    assert _golden(r1["report"]) == _golden(r2["report"])
+    s2 = h2.status()
+    assert s2["packed"] is True
+    # The joiner never waited for a full time-slice rotation: one slice,
+    # no preempt of the running pack.
+    assert s2["slices"] == 1 and s2["preempts"] == 0
+
+
+def test_full_pack_yields_to_higher_priority_same_shape():
+    """Review regression: a FULL pack must count a higher-priority
+    same-shape arrival as a preemption contender (it cannot join — no
+    free lane — and without this it would starve past every quantum).
+    The suspended members' payload slices then resume into later packs,
+    still exact."""
+    svc = CheckService(
+        quantum_s=0.2, default_spawn=dict(SPAWN_2PC),
+        max_pack_tenants=2,
+    )
+    try:
+        lows = [
+            svc.submit(model_name="2pc", model_args={"rm_count": 4})
+            for _ in range(2)
+        ]
+        deadline = time.monotonic() + 60
+        while (
+            any(svc.job(h.job_id).state == "queued" for h in lows)
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.002)
+        lows_running = all(
+            svc.job(h.job_id).state == "running" for h in lows
+        )
+        high = svc.submit(
+            model_name="2pc", model_args={"rm_count": 4}, priority=5
+        )
+        assert high.result(timeout=300)["unique"] == UNIQUE_2PC4
+        for h in lows:
+            assert h.result(timeout=300)["unique"] == UNIQUE_2PC4
+        if lows_running:
+            # The full pack actually yielded: its members were
+            # lane-dropped (suspended) at least once.
+            assert sum(
+                svc.job(h.job_id).preempts for h in lows
+            ) >= 1
+    finally:
+        svc.close()
+
+
+def test_service_surfaces_non_packable_reasons(service):
+    """Honesty satellite: every disqualifier is named in status() (and
+    therefore over GET /jobs/<id>), not silently degraded."""
+    cases = [
+        (dict(spawn={"attribution": True}), "spawn overrides"),
+        (dict(options={"symmetry": True}), "symmetry"),
+        (dict(options={"target_state_count": 50}), "target_state_count"),
+    ]
+    for kwargs, needle in cases:
+        h = service.submit(
+            model_name="2pc", model_args={"rm_count": 3}, **kwargs
+        )
+        st = h.status()
+        assert st["packable"] is False
+        assert needle in st["packable_reason"], st["packable_reason"]
+        h.cancel()
+    # A SERVICE-WIDE default the packed engine cannot honor (e.g. a
+    # pipeline override) disqualifies packing too — silently dropping
+    # it would make packed and time-sliced runs diverge semantically.
+    svc2 = CheckService(
+        quantum_s=0.75,
+        default_spawn=dict(SPAWN_2PC, expand_fps=False),
+    )
+    try:
+        h = svc2.submit(model_name="2pc", model_args={"rm_count": 3})
+        st = h.status()
+        assert st["packable"] is False
+        assert "default_spawn" in st["packable_reason"]
+        h.cancel()
+    finally:
+        svc2.close()
+
+
+def test_service_non_preemptible_backend_surfaced():
+    """A host-engine service (no preempt payloads) reports
+    ``preemptible: false`` from the live checker — the operator sees
+    that this job class serializes the device."""
+    svc = CheckService(
+        quantum_s=0.2, spawn_method="spawn_bfs", packing=False,
+    )
+    # The device-spawn defaults don't apply to a host engine.
+    svc.default_spawn = {}
+    try:
+        h = svc.submit(model_name="2pc", model_args={"rm_count": 3})
+        assert h.result(timeout=180)["unique"] == UNIQUE_2PC3
+        assert h.status()["preemptible"] is False
+    finally:
+        svc.close()
+
+
+def test_budget_rejected_at_admission(service):
+    """Satellite 2: an over-budget request fails AT SUBMIT with a clear
+    error (not at OOM on the scheduler thread), and an admissible budget
+    derives the job's table capacity instead of the fixed default."""
+    with pytest.raises(ValueError, match="rejected at admission"):
+        service.submit(
+            model_name="2pc", model_args={"rm_count": 4},
+            hbm_budget_mib=0.001,
+        )
+    from stateright_tpu.checker.tpu import min_admissible_hbm_budget_mib
+    from stateright_tpu.storage import max_table_rows_for_budget
+
+    budget = min_admissible_hbm_budget_mib(TwoPhaseSys(4), 16)
+    h = service.submit(
+        model_name="2pc", model_args={"rm_count": 4},
+        hbm_budget_mib=budget,
+    )
+    job = service.job(h.job_id)
+    assert job.derived_table_capacity == max_table_rows_for_budget(budget)
+    assert job.packable is False  # budgeted jobs run solo tiered
+    h.cancel()
+
+
+def test_tenant_metric_family_hygiene():
+    """The new ``pack.tenant.*`` family (and the engine's ``pack.*``
+    wave family) survive the Prometheus sanitizer without collisions —
+    the registry lint the tier-1 suite runs over every metric family."""
+    from stateright_tpu.telemetry import (
+        TenantInstruments,
+        WaveInstruments,
+        registry_hygiene_problems,
+    )
+    from stateright_tpu.telemetry.metrics import MetricsRegistry
+
+    reg = MetricsRegistry()
+    TenantInstruments("pack", registry=reg)
+    wi = WaveInstruments("pack", registry=reg)
+    wi.bucket_dispatch(16)
+    assert registry_hygiene_problems(reg) == []

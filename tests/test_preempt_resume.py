@@ -250,3 +250,38 @@ def test_preempt_resume_sharded():
     assert resumed.state_count() == reference.state_count()
     assert resumed._discoveries_fp == reference._discoveries_fp
     resumed.assert_properties()
+
+
+def test_solo_preempt_payload_admits_into_pack(uninterrupted_2pc4):
+    """Cross-path resume (PR 12): a SOLO checker's preempt payload
+    admits into a tenant-packed engine — the packed continuation is
+    bit-identical to the uninterrupted solo run. (The reverse direction
+    — a dropped tenant's payload slice resuming on a solo checker — is
+    tests/test_packed_tenancy.py.)"""
+    from stateright_tpu.checker.packed_tenancy import TenantPackedEngine
+
+    checker = TwoPhaseSys(4).checker().spawn_tpu_bfs(
+        max_drain_waves=2, **SPAWN_2PC4
+    )
+    if not _preempt_at(checker, threshold=200):
+        pytest.skip("run finished before the preempt landed")
+    engine = TenantPackedEngine(
+        TwoPhaseSys(4),
+        frontier_capacity=16, table_capacity=1 << 12, max_tenants=4,
+        aot_cache="t-pack-resume",
+    )
+    view = engine.admit(
+        "resumed", "pk-xr", resume_from=checker.preempt_payload()
+    )
+    steps = 0
+    while engine.live_count():
+        engine.step()
+        steps += 1
+        assert steps < 20_000
+    engine.close()
+    assert view.unique_state_count() == (
+        uninterrupted_2pc4.unique_state_count()
+    )
+    assert view.state_count() == uninterrupted_2pc4.state_count()
+    assert view.max_depth() == uninterrupted_2pc4.max_depth()
+    assert _golden(view) == _golden(uninterrupted_2pc4)

@@ -67,22 +67,30 @@ def test_single_job_full_verdict(service):
     assert lat["ttfv_s"] is not None  # 2pc's sometimes props discover
 
 
-def test_concurrent_jobs_preempt_and_stay_exact(service):
-    """Two equal-priority contending jobs time-slice the device at wave
-    granularity (round-robin at each quantum); both verdicts match the
-    batch path exactly and their golden reports agree with each other
-    (identical workload)."""
-    h1 = service.submit(model_name="2pc", model_args={"rm_count": 4})
-    h2 = service.submit(model_name="2pc", model_args={"rm_count": 4})
-    r1 = h1.result(timeout=300)
-    r2 = h2.result(timeout=300)
-    assert r1["unique"] == UNIQUE_2PC4
-    assert r2["unique"] == UNIQUE_2PC4
-    assert _golden(r1["report"]) == _golden(r2["report"])
-    # Contention existed, so at least one job was preempted mid-run —
-    # and its result is still exact (the bit-identical guarantee under
-    # real scheduling, not just the direct-API test).
-    assert h1.status()["preempts"] + h2.status()["preempts"] >= 1
+def test_concurrent_jobs_preempt_and_stay_exact():
+    """The TIME-SLICE path (packing disabled — PR 12's packer would
+    co-schedule these): two equal-priority contending jobs round-robin
+    the device at wave granularity; both verdicts match the batch path
+    exactly and their golden reports agree with each other (identical
+    workload). Packed co-scheduling of the same pair is covered by
+    tests/test_packed_tenancy.py."""
+    svc = CheckService(
+        quantum_s=0.75, default_spawn=dict(SPAWN_2PC), packing=False
+    )
+    try:
+        h1 = svc.submit(model_name="2pc", model_args={"rm_count": 4})
+        h2 = svc.submit(model_name="2pc", model_args={"rm_count": 4})
+        r1 = h1.result(timeout=300)
+        r2 = h2.result(timeout=300)
+        assert r1["unique"] == UNIQUE_2PC4
+        assert r2["unique"] == UNIQUE_2PC4
+        assert _golden(r1["report"]) == _golden(r2["report"])
+        # Contention existed, so at least one job was preempted mid-run —
+        # and its result is still exact (the bit-identical guarantee under
+        # real scheduling, not just the direct-API test).
+        assert h1.status()["preempts"] + h2.status()["preempts"] >= 1
+    finally:
+        svc.close()
 
 
 def test_high_priority_job_overtakes_running_low():
@@ -391,7 +399,13 @@ def test_http_front_end():
             .decode()
         )
         assert f'run_id="{ids[0]}"' in text
-        assert "stateright_tpu_bfs_states_unique_total" in text
+        # Packed jobs carry their per-tenant lane accounting; a job that
+        # fell back to time-slicing carries the solo wave family. Either
+        # way the per-run registry is populated and labeled.
+        assert (
+            "stateright_pack_tenant_states_unique_total" in text
+            or "stateright_tpu_bfs_states_unique_total" in text
+        )
 
         # Aggregate /metrics exports every run under its label, with at
         # most ONE TYPE line per metric family (spec-valid exposition —
