@@ -1007,6 +1007,15 @@ def _run_service_leg(pin_cpu: bool, packed: bool = False):
                     "preempts": st["preempts"],
                     "slices": st["slices"],
                     "packed": st.get("packed", False),
+                    # Fault-tolerance evidence (PR 13): a healthy bench
+                    # run shows zeros; a chaos leg shows the recovery.
+                    # (A quarantined job would have raised at result()
+                    # above, so this is False here by construction —
+                    # recorded anyway so report readers key on a real
+                    # field.)
+                    "retries": st.get("retries", 0),
+                    "faults": len(st.get("faults") or []),
+                    "quarantined": st.get("state") == "quarantined",
                     "rate": r["rate"],
                     "compile_s": compile_s,
                 }
@@ -1017,6 +1026,8 @@ def _run_service_leg(pin_cpu: bool, packed: bool = False):
         out["p50_ttfv_s"] = _pct(ttfvs, 50)
         out["p99_ttfv_s"] = _pct(ttfvs, 99)
         out["preempts_total"] = sum(j["preempts"] for j in per_job)
+        out["retries_total"] = sum(j["retries"] for j in per_job)
+        out["faults_total"] = sum(j["faults"] for j in per_job)
         out["jobs_zero_compile"] = zero_compile
         out["per_job"] = per_job
         # Steady-state aggregate (compile excluded — the same window

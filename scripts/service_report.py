@@ -68,6 +68,19 @@ def summarize(rec):
         "p50_ttfv_s": rec.get("p50_ttfv_s"),
         "p99_ttfv_s": rec.get("p99_ttfv_s"),
         "preempts_total": rec.get("preempts_total"),
+        # Fault-tolerance columns (PR 13): zero on healthy runs, the
+        # recovery evidence on chaos legs.
+        "retries_total": rec.get(
+            "retries_total",
+            sum(j.get("retries", 0) for j in per_job),
+        ),
+        "faults_total": rec.get(
+            "faults_total",
+            sum(j.get("faults", 0) for j in per_job),
+        ),
+        "jobs_quarantined": sum(
+            1 for j in per_job if j.get("quarantined")
+        ),
         "jobs_zero_compile": rec.get("jobs_zero_compile"),
         "per_job": per_job,
     }
@@ -120,12 +133,17 @@ def render(summary, out=sys.stdout):
     w(
         f"  scheduling: {summary['preempts_total']} preemptions; "
         f"{summary['jobs_zero_compile']}/{summary['jobs']} jobs "
-        "compile-free (shared AOT cache)\n\n"
+        "compile-free (shared AOT cache)\n"
+    )
+    w(
+        f"  fault tolerance: {summary['faults_total'] or 0} faults, "
+        f"{summary['retries_total'] or 0} retries, "
+        f"{summary['jobs_quarantined']} quarantined\n\n"
     )
     header = (
         f"  {'job':<10} {'tenant':<10} {'ttfv_s':>8} {'wall_s':>8} "
         f"{'queued_s':>9} {'rate':>10} {'preempts':>8} {'slices':>6} "
-        f"{'packed':>6} {'compile_s':>9}\n"
+        f"{'packed':>6} {'faults':>6} {'retries':>7} {'compile_s':>9}\n"
     )
     w(header)
     w("  " + "-" * (len(header) - 3) + "\n")
@@ -138,6 +156,7 @@ def render(summary, out=sys.stdout):
             f"{_fmt(j.get('rate')):>10} "
             f"{j.get('preempts', 0):>8} {j.get('slices', 0):>6} "
             f"{str(bool(j.get('packed', False))):>6} "
+            f"{j.get('faults', 0):>6} {j.get('retries', 0):>7} "
             f"{_fmt(j.get('compile_s'), '{:.2f}'):>9}\n"
         )
 

@@ -83,6 +83,7 @@ from ..telemetry import (
     get_tracer,
     metrics_registry,
 )
+from ..utils.faults import TenantFaultError, fault_point
 from .base import Checker
 from .pipeline import HostPipeline
 from .tpu import (
@@ -188,6 +189,15 @@ class _Tenant:
         self.resident: List[np.ndarray] = []
         self.done = False      # no further lanes scheduled
         self.finished = False  # reported complete (view.is_done)
+        # A fault was attributed to this tenant: it is rolled back to
+        # its pre-wave boundary, excluded from further scheduling, and
+        # waits for the service to drop() it (its payload slice is
+        # exact — see TenantPackedEngine._tenant_rollback).
+        # ``fault_error`` keeps THIS tenant's own exception — several
+        # tenants can fault in one wave, and each one's retry filter
+        # and flight dump must see its own error, not the first's.
+        self.faulted = False
+        self.fault_error: Optional[BaseException] = None
         self.compile_offset = 0.0
         self.view: Optional["TenantRun"] = None
 
@@ -939,19 +949,36 @@ class TenantPackedEngine:
         before the flush (the FIFO merge fence, engine-side)."""
         if self._pipe is not None:
             self._pipe.drain()
+        deferred: Optional[TenantFaultError] = None
         for t in self.tenants():
-            if t.resident:
-                fps = np.unique(np.concatenate(t.resident))
-                if len(fps):
-                    self._partitions.store(
-                        t.key, registry=t.registry
-                    ).evict(fps)
-                t.resident = []
+            if t.resident and not t.faulted:
+                try:
+                    # Injection seam: one tenant's partition eviction
+                    # dies (spill ENOSPC included — the partition store
+                    # carries the same owner tag). Contained: the other
+                    # tenants' claims still absorb, and the faulted
+                    # tenant's payload rebuilds its visited set from
+                    # the parent log, not from `resident`.
+                    fault_point("pack.tenant.evict", tenant=t.key)
+                    fps = np.unique(np.concatenate(t.resident))
+                    if len(fps):
+                        self._partitions.store(
+                            t.key, registry=t.registry
+                        ).evict(fps)
+                    t.resident = []
+                except BaseException as e:  # noqa: BLE001 - per-tenant
+                    t.faulted = True
+                    t.fault_error = e
+                    if deferred is None:
+                        deferred = TenantFaultError(t.key, e)
+                        deferred.__cause__ = e
         self._capacity = self._max_capacity
         self._table = hashset_new(self._capacity)
         self._l0 = 0
         self._wi.capacity.set(self._capacity)
         self._tracer.instant("pack.evict", capacity=self._capacity)
+        if deferred is not None:
+            raise deferred
 
     # -- the packed wave loop ----------------------------------------------
 
@@ -1034,31 +1061,77 @@ class TenantPackedEngine:
             dc[t.slot] = min(t.depth_cap, _DEPTH_INF)
         return sh, sl, dc
 
+    def _schedulable(self) -> List[_Tenant]:
+        return [
+            t
+            for t in self.tenants()
+            if not t.done and not t.finished and not t.faulted
+            and t.lanes.pending > 0
+        ]
+
+    def _tenant_snapshot(self, t: _Tenant) -> dict:
+        """Everything one wave can mutate for a tenant, captured BEFORE
+        ``_assemble`` consumes its lanes. Blocks are immutable once
+        pushed, so snapshotting the deque as a list of references is
+        exact and cheap — a fault rolls the tenant back to this
+        boundary bit-identically (the fault-containment contract)."""
+        with t.lanes._lock:
+            blocks = list(t.lanes._blocks)
+        return dict(
+            state_count=t.state_count,
+            unique_count=t.unique_count,
+            max_depth=t.max_depth,
+            discoveries=dict(t.discoveries_fp),
+            wave_log_len=len(t.wave_log),
+            resident_len=len(t.resident),
+            lane_blocks=blocks,
+            done=t.done,
+        )
+
+    def _tenant_rollback(self, t: _Tenant, snap: dict) -> None:
+        """Restores a tenant to its pre-wave snapshot after a fault:
+        scalars and discoveries rewind, append-only logs truncate, and
+        the lane deque is restored wholesale (consumed inputs included,
+        survivor pushes dropped), so ``drop()`` hands back the exact
+        last-good-wave-boundary payload. ``resident`` only truncates —
+        an eviction that replaced it with [] absorbed those keys into
+        the partition, which must not be undone."""
+        t.state_count = snap["state_count"]
+        t.unique_count = snap["unique_count"]
+        t.max_depth = snap["max_depth"]
+        t.discoveries_fp = dict(snap["discoveries"])
+        del t.wave_log[snap["wave_log_len"]:]
+        del t.resident[snap["resident_len"]:]
+        with t.lanes._lock:
+            t.lanes._blocks = deque(snap["lane_blocks"])
+            t.lanes.pending = sum(n for _b, n in snap["lane_blocks"])
+        t.done = snap["done"]
+
     def step(self) -> List[object]:
         """One packed wave (or a finish pass when no lanes are pending).
         Returns the tenant keys that COMPLETED during this step; fetch
         their ``view()`` for verdicts. Raises on engine errors — the
-        caller owns failure routing."""
-        ready = [
-            t
-            for t in self.tenants()
-            if not t.done and not t.finished and t.lanes.pending > 0
-        ]
+        caller owns failure routing. A :class:`TenantFaultError`
+        (synchronous mode) is the blast-radius contract: the named
+        tenant is rolled back to its pre-wave boundary and excluded
+        from scheduling (drop it for its exact payload slice) while
+        every other tenant's state is already consistent — the caller
+        keeps stepping the survivors. In async-pipeline mode faults
+        surface as pipeline poisoning and are never attributable (the
+        poisoned worker skips later tenants' verdicts), so callers must
+        treat them engine-wide."""
+        ready = self._schedulable()
         if not ready:
             if self._pipe is not None and self._pipe.pending():
                 # Survivors may still be in flight; only an empty queue
                 # AFTER the barrier means a tenant is exhausted.
                 self._pipe.drain()
-                ready = [
-                    t
-                    for t in self.tenants()
-                    if not t.done and not t.finished
-                    and t.lanes.pending > 0
-                ]
+                ready = self._schedulable()
             if not ready:
                 return self._finish_idle()
         if self._pipe is not None:
             self._pipe.throttle()
+        snaps = {t.slot: (t, self._tenant_snapshot(t)) for t in ready}
         width, lanes_by_slot, frontier = self._assemble(ready)
         sh, sl, dc = self._salt_arrays()
         self.waves += 1
@@ -1066,38 +1139,84 @@ class TenantPackedEngine:
         self.lanes_dispatched += width
         self._c_lanes_live.inc(sum(lanes_by_slot.values()))
         self._c_lanes_dispatched.inc(width)
-        with self._tracer.span(
-            "pack.wave", wave=self.waves, bucket=width,
-            tenants=len(lanes_by_slot),
-        ) as span:
-            gens, news = self._run_attempts(
-                frontier, width, lanes_by_slot, sh, sl, dc
-            )
-            self._wi.record(
-                span,
-                frontier=width,
-                generated=int(gens.sum()),
-                n_new=int(news.sum()),
-                occupancy=self._l0 / self._capacity,
-                capacity=self._capacity,
-                max_depth=max(
-                    (t.max_depth for t in self.tenants()), default=0
-                ),
-                bucket=width,
-                compaction_ratio=sum(lanes_by_slot.values()) / width,
+        try:
+            with self._tracer.span(
+                "pack.wave", wave=self.waves, bucket=width,
                 tenants=len(lanes_by_slot),
-            )
+            ) as span:
+                gens, news = self._run_attempts(
+                    frontier, width, lanes_by_slot, sh, sl, dc
+                )
+                self._wi.record(
+                    span,
+                    frontier=width,
+                    generated=int(gens.sum()),
+                    n_new=int(news.sum()),
+                    occupancy=self._l0 / self._capacity,
+                    capacity=self._capacity,
+                    max_depth=max(
+                        (t.max_depth for t in self.tenants()), default=0
+                    ),
+                    bucket=width,
+                    compaction_ratio=sum(lanes_by_slot.values()) / width,
+                    tenants=len(lanes_by_slot),
+                )
+        except TenantFaultError as e:
+            if self._pipe is None:
+                if e.pre_dispatch:
+                    # The wave never executed: every participant's
+                    # consumed inputs go back where they came from.
+                    for t, snap in snaps.values():
+                        self._tenant_rollback(t, snap)
+                else:
+                    # Roll back EVERY tenant flagged during this wave
+                    # (an eviction can fault several at once), not just
+                    # the one the raised error names — each must leave
+                    # with an exact pre-wave payload.
+                    for t, snap in snaps.values():
+                        if t.faulted or t.key == e.tenant_key:
+                            self._tenant_rollback(t, snap)
+                ft = self._by_key.get(e.tenant_key)
+                if ft is not None:
+                    ft.faulted = True
+                self._tracer.instant(
+                    "pack.tenant_fault", tenant=str(e.tenant_key),
+                    pre_dispatch=e.pre_dispatch,
+                )
+            raise
         return self._finish_idle()
+
+    def faulted_keys(self) -> List[object]:
+        """Every resident tenant currently flagged faulted — the caller
+        must drop each one (a single wave can fault several tenants,
+        e.g. one eviction pass over every partition); leaving a flagged
+        tenant resident would exclude it from scheduling while still
+        counting it live."""
+        return [t.key for t in self.tenants() if t.faulted]
+
+    def fault_error(self, key) -> Optional[BaseException]:
+        """The flagged tenant's OWN exception (each co-faulted tenant
+        keeps its own — retry filtering and forensics must not read
+        another tenant's error)."""
+        t = self._by_key.get(key)
+        return t.fault_error if t is not None else None
 
     def _run_attempts(self, frontier, width, lanes_by_slot, sh, sl, dc):
         """Dispatch + growth-retry loop for one packed wave; returns the
         per-slot (generated, fresh) vectors of the first attempt /
         accumulated fresh."""
         K = self._K
-        self._ensure_capacity(width * self._A)
+        try:
+            self._ensure_capacity(width * self._A)
+        except TenantFaultError as e:
+            # Pre-dispatch eviction fault: nothing executed yet, so the
+            # caller can restore EVERY participant's inputs exactly.
+            e.pre_dispatch = True
+            raise
         gens = np.zeros((K,), np.int64)
         news = np.zeros((K,), np.int64)
         attempt = 0
+        deferred: Optional[TenantFaultError] = None
         while True:
             args = (
                 self._table,
@@ -1116,6 +1235,11 @@ class TenantPackedEngine:
                 "wave", self._jit_wave, args,
                 (self._table.shape[0], width),
             )
+            # Injection seam: a packed device-wave raise is inherently
+            # engine-wide (every tenant's lanes ride the one dispatch)
+            # — the service retries all members solo from their last
+            # checkpointed boundaries.
+            fault_point("device.wave")
             out = exe(*args)
             self._table = out["table"]
             stats = np.asarray(out["stats"])
@@ -1139,10 +1263,21 @@ class TenantPackedEngine:
                 lanes_by_slot=lanes_by_slot if attempt == 0 else {},
             )
             if self._pipe is None:
-                self._verdict(ticket)
+                try:
+                    self._verdict(ticket)
+                except TenantFaultError as e:
+                    # Defer: the remaining growth attempts must still
+                    # run so every OTHER tenant's wave completes in
+                    # full — the faulted tenant (already flagged) is
+                    # skipped by later verdicts and rolled back by the
+                    # caller.
+                    if deferred is None:
+                        deferred = e
             else:
                 self._pipe.submit(lambda tk=ticket: self._verdict(tk))
             if not overflow:
+                if deferred is not None:
+                    raise deferred
                 return gens, news
             if self._max_capacity is not None and attempt >= 8:
                 raise RuntimeError(
@@ -1151,7 +1286,16 @@ class TenantPackedEngine:
                     "evictions; raise the budget or shrink "
                     "frontier_capacity"
                 )
-            self._grow(self._capacity * 2)
+            try:
+                self._grow(self._capacity * 2)
+            except TenantFaultError as e:
+                # Mid-wave eviction fault: the overflow retry this grow
+                # was serving never runs, so EVERY tenant's wave is
+                # incomplete — per-tenant attribution would be a lie.
+                raise RuntimeError(
+                    "packed eviction failed mid-wave (overflow retry "
+                    "pending); engine-wide fault"
+                ) from e
             attempt += 1
 
     def _apply_stats(self, gen_t, maxd_t, any_hit, out) -> None:
@@ -1198,44 +1342,72 @@ class TenantPackedEngine:
             states = jax.tree_util.tree_map(
                 lambda x: np.asarray(x)[:n_total], new["states"]
             )
+        deferred: Optional[TenantFaultError] = None
         for t in self.tenants():
+            if t.faulted:
+                # A flagged tenant's verdict slice is skipped: it is
+                # rolled back to its pre-wave boundary either way, so
+                # applying (or half-applying) this wave would only
+                # corrupt the payload it leaves with.
+                continue
             k = t.slot
             n_k = int(ticket["new_t"][k])
             survivors = 0
             stale = 0
-            if n_k and not t.done:
-                sel = np.flatnonzero(tid == k)
-                child = fp64_pairs(hi[sel], lo[sel])
-                keep = np.arange(len(sel))
-                store = self._partitions.get(t.key)
-                if store is not None and not store.is_empty():
-                    stale_mask = store.probe(child)
-                    stale = int(stale_mask.sum())
-                    keep = np.flatnonzero(~stale_mask)
-                survivors = len(keep)
-                if survivors:
-                    kept = sel[keep]
-                    child = child[keep]
-                    parent = fp64_pairs(parent_hi[kept], parent_lo[kept])
-                    t.wave_log.append((child, parent))
-                    t.resident.append(child)
-                    t.unique_count += survivors
-                    block = {
-                        "states": jax.tree_util.tree_map(
-                            lambda x: x[kept], states
-                        ),
-                        "hi": hi[kept],
-                        "lo": lo[kept],
-                        "ebits": ebits[kept],
-                        "depth": depth[kept],
-                    }
-                    t.lanes.push(block, survivors)
-            elif n_k and t.done:
-                # Discovery-complete tenants discard late fresh lanes
-                # (the solo loop would never have expanded them either
-                # way; their claims are table garbage like a dropped
-                # tenant's).
-                pass
+            try:
+                if n_k and not t.done:
+                    # Injection seam: one tenant's host-tier verdict
+                    # slice dies (its probe, its numpy, its partition)
+                    # — the pack-local blast-radius case. Fires before
+                    # any of this tenant's state mutates, and the
+                    # partition probe below carries the same per-tenant
+                    # owner tag.
+                    fault_point("pack.tenant.verdict", tenant=t.key)
+                    sel = np.flatnonzero(tid == k)
+                    child = fp64_pairs(hi[sel], lo[sel])
+                    keep = np.arange(len(sel))
+                    store = self._partitions.get(t.key)
+                    if store is not None and not store.is_empty():
+                        stale_mask = store.probe(child)
+                        stale = int(stale_mask.sum())
+                        keep = np.flatnonzero(~stale_mask)
+                    survivors = len(keep)
+                    if survivors:
+                        kept = sel[keep]
+                        child = child[keep]
+                        parent = fp64_pairs(
+                            parent_hi[kept], parent_lo[kept]
+                        )
+                        t.wave_log.append((child, parent))
+                        t.resident.append(child)
+                        t.unique_count += survivors
+                        block = {
+                            "states": jax.tree_util.tree_map(
+                                lambda x: x[kept], states
+                            ),
+                            "hi": hi[kept],
+                            "lo": lo[kept],
+                            "ebits": ebits[kept],
+                            "depth": depth[kept],
+                        }
+                        t.lanes.push(block, survivors)
+                elif n_k and t.done:
+                    # Discovery-complete tenants discard late fresh
+                    # lanes (the solo loop would never have expanded
+                    # them either way; their claims are table garbage
+                    # like a dropped tenant's).
+                    pass
+            except BaseException as e:  # noqa: BLE001 - contained per tenant
+                # Flag now (later attempts of this wave skip the
+                # tenant) and defer the raise so every OTHER tenant's
+                # slice of this verdict still applies — the whole point
+                # of a pack-local blast radius.
+                t.faulted = True
+                t.fault_error = e
+                if deferred is None:
+                    deferred = TenantFaultError(t.key, e)
+                    deferred.__cause__ = e
+                continue
             lanes_k = ticket["lanes_by_slot"].get(k, 0)
             if lanes_k or n_k:
                 if stale:
@@ -1248,6 +1420,8 @@ class TenantPackedEngine:
                     pending=t.lanes.pending,
                     max_depth=t.max_depth,
                 )
+        if deferred is not None:
+            raise deferred
 
     def _ensure_capacity(self, incoming: int) -> None:
         need = self._l0 + incoming
@@ -1270,7 +1444,8 @@ class TenantPackedEngine:
             return [
                 t
                 for t in self.tenants()
-                if not t.finished and (t.done or t.lanes.pending == 0)
+                if not t.finished and not t.faulted
+                and (t.done or t.lanes.pending == 0)
             ]
 
         if not scan():
@@ -1312,6 +1487,8 @@ class TenantPackedEngine:
         if self._pipe is not None:
             try:
                 self._pipe.drain()
+            except Exception:  # noqa: BLE001 - poisoned: already surfaced
+                pass
             finally:
                 self._pipe.close()
 

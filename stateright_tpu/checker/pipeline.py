@@ -29,9 +29,15 @@ Correctness rests on three properties this class enforces:
   device output buffers are pinned at once.
 
 A job that raises poisons the pipeline: later jobs are skipped (their
-inputs may depend on the failed verdict) and the error surfaces at the
-next ``submit``/``throttle``/``drain`` on the checker thread, which
-routes it into ``worker_error()`` like any other worker failure.
+inputs may depend on the failed verdict) and the error surfaces as a
+typed :class:`PipelinePoisonedError` — carrying the original worker
+exception as its ``cause``/``__cause__`` — at the next
+``submit``/``throttle``/``drain`` on the checker thread, which routes it
+into ``worker_error()`` like any other worker failure. Poisoning never
+hangs the teardown path: the worker loop keeps draining (skipping) the
+queue, so ``close()`` joins, and every tiered-store mutation runs under
+``with`` blocks, so no store lock outlives a dying job
+(tests/test_faults.py pins both).
 """
 
 from __future__ import annotations
@@ -40,7 +46,24 @@ import threading
 from collections import deque
 from typing import Callable, Optional
 
-__all__ = ["HostPipeline"]
+from ..utils.faults import fault_point
+
+__all__ = ["HostPipeline", "PipelinePoisonedError"]
+
+
+class PipelinePoisonedError(RuntimeError):
+    """The async host pipeline is poisoned: a worker job raised, so no
+    further host-tier work can be applied. ``cause`` (also
+    ``__cause__``) is the original worker exception — callers routing
+    failures (the service's retry classifier) look through this wrapper
+    at the root fault."""
+
+    def __init__(self, cause: BaseException):
+        super().__init__(
+            "async host pipeline failed; no further host-tier work "
+            f"can be applied (worker error: {cause!r})"
+        )
+        self.cause = cause
 
 # Default pending-verdict depth: the producing wave plus one in-flight
 # verdict — the "two-deep" in the two-deep pipeline. Deeper queues pin
@@ -123,10 +146,7 @@ class HostPipeline:
 
     def _raise_if_poisoned(self) -> None:
         if self._error is not None:
-            raise RuntimeError(
-                "async host pipeline failed; no further host-tier work "
-                "can be applied"
-            ) from self._error
+            raise PipelinePoisonedError(self._error) from self._error
 
     # -- worker thread ------------------------------------------------------
 
@@ -141,6 +161,11 @@ class HostPipeline:
                 poisoned = self._error is not None
             try:
                 if not poisoned:
+                    # Injection seam: a fault here IS a worker death —
+                    # the job never runs and the pipeline poisons,
+                    # exactly the shape a segfaulting probe or a dying
+                    # numpy allocation would produce.
+                    fault_point("pipeline.worker")
                     fn()
             except BaseException as e:  # noqa: BLE001 - surfaced at barriers
                 with self._cv:

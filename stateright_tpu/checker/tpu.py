@@ -68,6 +68,7 @@ from ..telemetry import (
     get_tracer,
     metrics_registry,
 )
+from ..utils.faults import fault_point
 from .base import _NULL_CTX, Checker  # noqa: F401 - _NULL_CTX re-exported
 from .pipeline import HostPipeline
 
@@ -206,6 +207,11 @@ def atomic_pickle(path, payload) -> None:
     import os
     import pickle
 
+    # Injection seam: a real checkpoint write fails on ENOSPC, a torn
+    # NFS rename, or fs remount — always BEFORE the rename, so the
+    # previous checkpoint survives the fault (the atomicity guarantee
+    # this function exists for).
+    fault_point("checkpoint.write")
     tmp = f"{path}.tmp"
     with open(tmp, "wb") as f:
         pickle.dump(payload, f)
@@ -1685,6 +1691,11 @@ class TpuBfsChecker(Checker):
         window. During the initial pre-first-result window
         ``warmup_seconds`` is still None and the caller's own stamp covers
         the compile."""
+        # Injection seam, PRE-dispatch: a device wave raise (XLA error,
+        # HBM OOM, tunnel drop) fires before any counter for this wave
+        # mutates — the retry-from-checkpoint path never sees a
+        # half-applied wave.
+        fault_point("device.wave")
         f_in = chunk["hi"].shape[0]
         if (
             len(self._buckets) > 1
@@ -2097,6 +2108,10 @@ class TpuBfsChecker(Checker):
         chunks = 0
         last_checkpoint = time.perf_counter()
         while True:
+            # Injection seam: a wedged wave (device tunnel hang, stuck
+            # host probe) simulated as a sleep — what the service's
+            # stall watchdog must detect and auto-preempt through.
+            fault_point("wave.stall")
             if pipe is not None and not queue and pipe.pending():
                 # In-flight verdicts may refill the queue (survivors
                 # land one wave late); only an empty queue AFTER the
@@ -2201,6 +2216,8 @@ class TpuBfsChecker(Checker):
         drains = 0
         last_checkpoint = time.perf_counter()
         while True:
+            # Injection seam: a wedged drain loop (see _explore_waves).
+            fault_point("wave.stall")
             if len(self._discoveries_fp) == len(props):
                 break
             if self._preempt_event.is_set():
@@ -2349,6 +2366,10 @@ class TpuBfsChecker(Checker):
                 # warmup and corrupt steady-state rates. Mid-run compiles
                 # (new rung, grown table/ring) are measured into warmup too.
                 exe = self._drain_exe(width, args, t_start)
+                # Injection seam, pre-dispatch (the deep-drain twin of
+                # _call_wave's site): the ring still holds the frontier,
+                # so nothing of this drain is half-applied on a raise.
+                fault_point("device.wave")
                 drain_span = self._tracer.span(
                     "tpu_bfs.drain", drain=drains, bucket=width
                 )
@@ -2464,6 +2485,13 @@ class TpuBfsChecker(Checker):
         steady-state window honest."""
         key = (width, args[0].shape[0], self._pool_capacity)
         exe = self._drain_exec.get(key)
+        if exe is not None and self.warmup_seconds is None:
+            # Warm start (shared AOT cache hit on the very first drain):
+            # stamp the setup-only warmup now. Leaving it None would
+            # both under-report the warm/steady split and make the
+            # service's stall watchdog treat the whole run as warmup
+            # (its pet condition defers to an unstamped warmup).
+            self._set_warmup(time.perf_counter() - t_start)
         if exe is None:
             jit_fn = self._drain_jits.get(width)
             if jit_fn is None:

@@ -74,6 +74,7 @@ from ..telemetry import (
 from .base_mesh import default_mesh
 from ..checker.base import Checker
 from ..checker.pipeline import HostPipeline
+from ..utils.faults import fault_point
 from ..checker.tpu import (
     _AUTO_BUCKET_MIN_F,
     _DEFAULT_BUCKET_STEPS,
@@ -1608,6 +1609,9 @@ class ShardedTpuBfsChecker(Checker):
         window — mirroring ``TpuBfsChecker._call_wave``. During the
         pre-first-result window ``warmup_seconds`` is None and the
         caller's own stamp covers the compile."""
+        # Injection seam (utils/faults.py): pre-dispatch, like the
+        # single-device checker's — no per-wave state mutated yet.
+        fault_point("device.wave")
         args = (
             table,
             dev["states"],

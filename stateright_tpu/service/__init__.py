@@ -37,13 +37,16 @@ from .jobs import (
     JOB_CANCELLED,
     JOB_DONE,
     JOB_FAILED,
+    JOB_FAULTED,
+    JOB_QUARANTINED,
     JOB_QUEUED,
     JOB_RUNNING,
     JOB_SUSPENDED,
     CheckJob,
     JobHandle,
+    RetryPolicy,
 )
-from .service import CheckService
+from .service import CheckService, QueueFullError
 from .zoo import default_zoo
 
 # ServiceServer drags in http.server; resolve lazily (PEP 562) like the
@@ -66,9 +69,13 @@ __all__ = [
     "JOB_CANCELLED",
     "JOB_DONE",
     "JOB_FAILED",
+    "JOB_FAULTED",
+    "JOB_QUARANTINED",
     "JOB_QUEUED",
     "JOB_RUNNING",
     "JOB_SUSPENDED",
+    "QueueFullError",
+    "RetryPolicy",
     "ServiceServer",
     "default_zoo",
 ]

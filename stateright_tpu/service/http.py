@@ -46,7 +46,7 @@ from ..telemetry.server import (
     prometheus_text,
     prometheus_text_all_runs,
 )
-from .service import CheckService
+from .service import CheckService, QueueFullError
 
 # Spawn kwargs a REMOTE caller may set. Everything else is rejected:
 # `resume_from` would make the server pickle.load an attacker-chosen
@@ -70,13 +70,15 @@ _HTTP_SPAWN_KEYS = frozenset({
 })
 
 
-def _json_response(handler, payload, code=200) -> None:
-    _send(
-        handler,
-        json.dumps(payload, default=str).encode(),
-        "application/json",
-        code=code,
-    )
+def _json_response(handler, payload, code=200, headers=None) -> None:
+    body = json.dumps(payload, default=str).encode()
+    handler.send_response(code)
+    handler.send_header("Content-Type", "application/json")
+    handler.send_header("Content-Length", str(len(body)))
+    for name, value in (headers or {}).items():
+        handler.send_header(name, value)
+    handler.end_headers()
+    handler.wfile.write(body)
 
 
 class _ServiceHandler(BaseHTTPRequestHandler):
@@ -201,6 +203,15 @@ class _ServiceHandler(BaseHTTPRequestHandler):
                 400,
             )
             return
+        submit_kwargs = {}
+        if "retry" in body:
+            retry = body.get("retry")
+            if retry is not None and not isinstance(retry, dict):
+                _json_response(
+                    self, {"error": "retry must be an object"}, 400
+                )
+                return
+            submit_kwargs["retry_policy"] = retry
         try:
             # Raw values through: submit() coerces priority/deadline/
             # budget itself and raises ValueError on garbage (a list
@@ -214,7 +225,20 @@ class _ServiceHandler(BaseHTTPRequestHandler):
                 deadline_s=body.get("deadline_s"),
                 tenant=body.get("tenant"),
                 hbm_budget_mib=body.get("hbm_budget_mib"),
+                timeout_s=body.get("timeout_s"),
+                **submit_kwargs,
             )
+        except QueueFullError as e:
+            # Graceful degradation: a full admission queue is 429 with
+            # a Retry-After hint, not a 400 the client would never
+            # retry.
+            _json_response(
+                self,
+                {"error": str(e), "retry_after_s": e.retry_after_s},
+                429,
+                headers={"Retry-After": str(max(1, int(e.retry_after_s)))},
+            )
+            return
         except (ValueError, RuntimeError) as e:
             _json_response(self, {"error": str(e)}, 400)
             return

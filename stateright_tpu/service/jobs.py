@@ -4,10 +4,23 @@ caller-facing handle.
 A job's lifecycle::
 
     queued ──schedule──▶ running ──complete──▶ done
-       ▲                    │ │────fail──────▶ failed
-       │                    │ │────cancel────▶ cancelled
-       └─────suspended ◀────┘ (preempted at a wave boundary; the
+       ▲                  │ │ │────fail───────▶ failed
+       │                  │ │ │────cancel─────▶ cancelled
+       │                  │ │ └───fault───▶ faulted ──backoff──▶ (requeue)
+       │                  │ │                  └─retries exhausted─▶
+       │                  │ │                               quarantined
+       └─────suspended ◀──┘ (preempted at a wave boundary; the
              checkpoint payload re-enters the queue)
+
+``faulted`` is the self-healing state: a slice died (host probe, spill,
+device wave, pipeline worker, checkpoint write — see
+``utils/faults.classify_fault``), the scheduler harvested the best
+checkpoint payload it could (the job's pre-slice resume snapshot, or a
+fresher preempt payload when one landed), and the job re-enters the
+queue after an exponential backoff. A job that exhausts its
+:class:`RetryPolicy` lands in ``quarantined`` — terminal, with a
+flight-recorder-style dump (fault history, tracebacks, last state
+digest) attached to its status so the forensics survive the job.
 
 All mutation happens on the scheduler thread; readers (``status()``, the
 HTTP front-end) take the job lock only for the multi-field snapshots so a
@@ -23,19 +36,79 @@ from typing import Callable, Dict, Optional
 JOB_QUEUED = "queued"
 JOB_RUNNING = "running"
 JOB_SUSPENDED = "suspended"
+JOB_FAULTED = "faulted"
 JOB_DONE = "done"
 JOB_FAILED = "failed"
 JOB_CANCELLED = "cancelled"
+JOB_QUARANTINED = "quarantined"
 
-_TERMINAL = (JOB_DONE, JOB_FAILED, JOB_CANCELLED)
+_TERMINAL = (JOB_DONE, JOB_FAILED, JOB_CANCELLED, JOB_QUARANTINED)
+
+
+class RetryPolicy:
+    """Checkpointed-retry policy for faulted jobs: up to ``max_retries``
+    requeues with exponential backoff (``backoff_s`` doubling by
+    ``backoff_factor`` up to ``max_backoff_s``), optionally filtered to
+    a set of fault classes (``retry_on`` — names from
+    ``utils/faults.classify_fault``; None retries every class)."""
+
+    def __init__(self, max_retries: int = 2, backoff_s: float = 0.25,
+                 backoff_factor: float = 2.0, max_backoff_s: float = 30.0,
+                 retry_on=None):
+        self.max_retries = max(0, int(max_retries))
+        self.backoff_s = float(backoff_s)
+        self.backoff_factor = float(backoff_factor)
+        self.max_backoff_s = float(max_backoff_s)
+        self.retry_on = None if retry_on is None else frozenset(retry_on)
+
+    def allows(self, fault_class: str, attempt: int) -> bool:
+        """Whether retry number ``attempt`` (0-based) may run for a
+        fault of this class."""
+        if attempt >= self.max_retries:
+            return False
+        return self.retry_on is None or fault_class in self.retry_on
+
+    def delay_s(self, attempt: int) -> float:
+        return min(
+            self.backoff_s * (self.backoff_factor ** attempt),
+            self.max_backoff_s,
+        )
+
+    def to_dict(self) -> dict:
+        return {
+            "max_retries": self.max_retries,
+            "backoff_s": self.backoff_s,
+            "backoff_factor": self.backoff_factor,
+            "max_backoff_s": self.max_backoff_s,
+            "retry_on": (
+                sorted(self.retry_on) if self.retry_on is not None else None
+            ),
+        }
+
+    _FIELDS = ("max_retries", "backoff_s", "backoff_factor",
+               "max_backoff_s", "retry_on")
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "RetryPolicy":
+        d = d or {}
+        unknown = set(d) - set(cls._FIELDS)
+        if unknown:
+            # A typo'd key must be an error, not a silently-defaulted
+            # policy the operator never asked for.
+            raise ValueError(
+                f"unknown retry-policy keys {sorted(unknown)} "
+                f"(supported: {list(cls._FIELDS)})"
+            )
+        return cls(**d)
 
 
 class CheckJob:
     """One submitted check: the model factory + builder options + spawn
     kwargs, the tenant's scheduling class (``priority`` high-first,
     ``deadline_s`` earliest-first within a priority, FIFO within a
-    deadline), the per-tenant ``hbm_budget_mib``, and the run state the
-    scheduler threads through preempt/resume cycles."""
+    deadline), the per-tenant ``hbm_budget_mib``, the fault-tolerance
+    envelope (``retry_policy``, ``timeout_s``), and the run state the
+    scheduler threads through preempt/resume/retry cycles."""
 
     def __init__(
         self,
@@ -50,6 +123,8 @@ class CheckJob:
         tenant: Optional[str] = None,
         hbm_budget_mib: Optional[float] = None,
         aot_namespace: Optional[str] = None,
+        retry_policy: Optional[RetryPolicy] = None,
+        timeout_s: Optional[float] = None,
         seq: int = 0,
         clock=time.monotonic,
     ):
@@ -64,6 +139,8 @@ class CheckJob:
         self.tenant = tenant
         self.hbm_budget_mib = hbm_budget_mib
         self.aot_namespace = aot_namespace
+        self.retry_policy = retry_policy
+        self.timeout_s = timeout_s
         self.seq = seq
         self._clock = clock
         self._lock = threading.Lock()
@@ -72,8 +149,23 @@ class CheckJob:
         self.payload: Optional[dict] = None  # suspended checkpoint
         self.result: Optional[dict] = None
         self.error: Optional[str] = None
+        self.error_traceback: Optional[str] = None
         self.preempts = 0
         self.slices = 0
+        # Fault-tolerance ledger: ``retries`` counts requeues after a
+        # fault, ``faults`` is the per-fault history (class, error,
+        # time), ``flight`` the forensic dump attached on
+        # quarantine/failure, ``not_before`` the backoff gate a faulted
+        # job waits behind, ``stall_preempts`` watchdog auto-preempts.
+        self.retries = 0
+        self.faults: list = []
+        self.flight: Optional[dict] = None
+        self.not_before: Optional[float] = None
+        self.stall_preempts = 0
+        # Durability (service_dir mode): True when the submission is
+        # journalable (zoo name + JSON-safe spec) and would survive a
+        # process crash via CheckService.recover().
+        self.durable = False
         # Honest backend surfacing (the service fills these at admission
         # and corrects them from the live checker): ``preemptible`` —
         # the spawn method yields resumable preempt payloads (a False
@@ -125,7 +217,16 @@ class CheckJob:
         return (-self.priority, deadline, last_run, self.seq)
 
     def runnable(self) -> bool:
-        return self.state in (JOB_QUEUED, JOB_SUSPENDED)
+        if self.state in (JOB_QUEUED, JOB_SUSPENDED):
+            return True
+        if self.state == JOB_FAULTED:
+            # Backoff gate: a faulted job re-enters the queue only once
+            # its retry delay has elapsed.
+            return (
+                self.not_before is None
+                or self._clock() >= self.not_before
+            )
+        return False
 
     def finish(self, state: str) -> None:
         with self._lock:
@@ -151,13 +252,68 @@ class CheckJob:
             self.finished_t = self._clock()
         self.done_event.set()
 
-    def fail(self, error: str) -> None:
+    def fail(self, error: str, traceback_text: Optional[str] = None,
+             flight: Optional[dict] = None) -> None:
         with self._lock:
             self.error = error
+            self.error_traceback = traceback_text
+            if flight is not None:
+                self.flight = flight
             self.payload = None
             self.state = JOB_FAILED
             self.finished_t = self._clock()
         self.done_event.set()
+
+    def fault(self, fault_class: str, error: str,
+              traceback_text: Optional[str] = None,
+              payload: Optional[dict] = None,
+              digest: Optional[dict] = None) -> str:
+        """Routes one slice fault through the retry policy. Returns the
+        resulting state: ``faulted`` (requeued after backoff, resuming
+        from ``payload`` — the last good wave boundary the scheduler
+        harvested), ``quarantined`` (retries exhausted; the flight dump
+        lands on the job), or ``failed`` (no retry policy). The caller
+        counts the metrics — this object only owns the transition."""
+        now = self._clock()
+        record = {
+            "t": now,
+            "class": fault_class,
+            "error": error,
+            "retry": self.retries,
+        }
+        with self._lock:
+            self.faults.append(record)
+            policy = self.retry_policy
+            if policy is not None and policy.allows(
+                fault_class, self.retries
+            ):
+                delay = policy.delay_s(self.retries)
+                self.retries += 1
+                self.payload = payload
+                self.not_before = now + delay
+                self.state = JOB_FAULTED
+                return JOB_FAULTED
+            # Terminal: quarantine when retries were exhausted (the
+            # self-healing path gave up — keep the forensics), plain
+            # failure when no retry was ever on the table.
+            self.error = error
+            self.error_traceback = traceback_text
+            self.payload = None
+            self.flight = {
+                "error": error,
+                "traceback": traceback_text,
+                "fault_class": fault_class,
+                "faults": list(self.faults),
+                "retries": self.retries,
+                "digest": digest,
+            }
+            if policy is not None and self.retries >= policy.max_retries:
+                self.state = JOB_QUARANTINED
+            else:
+                self.state = JOB_FAILED
+            self.finished_t = now
+        self.done_event.set()
+        return self.state
 
     # -- views --------------------------------------------------------------
 
@@ -194,17 +350,24 @@ class CheckJob:
                 "priority": self.priority,
                 "deadline_s": self.deadline_s,
                 "hbm_budget_mib": self.hbm_budget_mib,
+                "timeout_s": self.timeout_s,
                 "state": self.state,
+                "durable": self.durable,
                 "preemptible": self.preemptible,
                 "packable": self.packable,
                 "packable_reason": self.packable_reason,
                 "packed": self.packed,
                 "preempts": self.preempts,
                 "slices": self.slices,
+                "retries": self.retries,
+                "faults": [dict(f) for f in self.faults],
+                "stall_preempts": self.stall_preempts,
                 "discoveries_so_far": sorted(self.seen_discoveries),
                 "latency": self.latency(),
                 "result": self.result,
                 "error": self.error,
+                "error_traceback": self.error_traceback,
+                "flight": self.flight,
             }
         return out
 
@@ -218,13 +381,16 @@ class CheckJob:
     def summary(self) -> dict:
         """``status()`` minus the heavy result payload — what the
         ``GET /jobs`` listing (polled every ~2s by the UI panel)
-        actually renders. Full verdicts stay on ``GET /jobs/<id>``."""
+        actually renders. Full verdicts (and the flight dump /
+        traceback forensics) stay on ``GET /jobs/<id>``."""
         out = self.status()
         result = out.get("result")
         if isinstance(result, dict):
             out["result"] = {
                 k: result.get(k) for k in self._SUMMARY_RESULT_FIELDS
             }
+        out.pop("flight", None)
+        out.pop("error_traceback", None)
         return out
 
 
@@ -258,14 +424,15 @@ class JobHandle:
 
     def result(self, timeout: Optional[float] = None) -> dict:
         """Blocks for the verdict. Raises ``TimeoutError`` on timeout,
-        ``RuntimeError`` for a failed or cancelled job."""
+        ``RuntimeError`` for a failed, quarantined, or cancelled job."""
         if not self._job.done_event.wait(timeout):
             raise TimeoutError(
                 f"job {self._job.job_id} not done within {timeout}s"
             )
-        if self._job.state == JOB_FAILED:
+        if self._job.state in (JOB_FAILED, JOB_QUARANTINED):
             raise RuntimeError(
-                f"job {self._job.job_id} failed: {self._job.error}"
+                f"job {self._job.job_id} {self._job.state}: "
+                f"{self._job.error}"
             )
         if self._job.state == JOB_CANCELLED:
             raise RuntimeError(f"job {self._job.job_id} was cancelled")

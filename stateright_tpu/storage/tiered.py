@@ -38,6 +38,7 @@ from typing import List, Optional
 import numpy as np
 
 from ..telemetry import get_tracer, metrics_registry
+from ..utils.faults import fault_point
 from .runs import FingerprintRun
 
 __all__ = [
@@ -214,6 +215,7 @@ class TieredVisitedStore:
         prefix: str = "tpu_bfs",
         shard: Optional[int] = None,
         tracer=None,
+        owner=None,
     ):
         if host_budget_mib is not None and spill_dir is None:
             raise ValueError(
@@ -238,6 +240,10 @@ class TieredVisitedStore:
         self._tracer = tracer if tracer is not None else get_tracer()
         self._span_prefix = self._instr.prefix
         self._shard = shard
+        # Fault-attribution tag (utils/faults.py): the tenant key for a
+        # packed partition, None for a solo store — what lets a chaos
+        # spec target exactly one tenant's host tier.
+        self._owner = owner
         self._seq = 0
         # The merge fence (see the module docstring): reentrant because
         # evict() holds it across the merges/spills it triggers.
@@ -307,9 +313,13 @@ class TieredVisitedStore:
             return
         while self.host_bytes > self._host_budget and self.l1:
             # Spill the largest L1 run: biggest single relief per file.
+            # Spill FIRST, then swap tiers: an ENOSPC mid-write must
+            # leave the run in L1 (membership intact, retryable on the
+            # next eviction), never dropped from both tiers.
             run = max(self.l1, key=lambda r: r.count)
+            spilled = self._spill_run(run)
             self.l1.remove(run)
-            self.l2.append(self._spill_run(run))
+            self.l2.append(spilled)
         if len(self.l2) >= self._merge_threshold:
             self._merge_l2()
 
@@ -327,6 +337,9 @@ class TieredVisitedStore:
             merged = np.unique(
                 np.concatenate([r.decode_all() for r in self.l2])
             )
+            # Write the merged run BEFORE destroying its sources: a
+            # spill failure here must leave every old run probeable.
+            new_run = self._spill_run(FingerprintRun.build(merged))
             for r in self.l2:
                 r.close()
                 if r.path is not None:
@@ -334,10 +347,13 @@ class TieredVisitedStore:
                         os.remove(r.path)
                     except OSError:
                         pass
-            self.l2 = [self._spill_run(FingerprintRun.build(merged))]
+            self.l2 = [new_run]
             self._instr.merges.inc()
 
     def _spill_run(self, run: FingerprintRun) -> FingerprintRun:
+        # Injection seam: ENOSPC / EIO at the spill write, before any
+        # tier list mutates (see _enforce_host_budget's ordering).
+        fault_point("storage.spill", tenant=self._owner)
         os.makedirs(self._spill_dir, exist_ok=True)
         shard_tag = "" if self._shard is None else f"s{self._shard}_"
         path = os.path.join(
@@ -361,6 +377,11 @@ class TieredVisitedStore:
         found = np.zeros(len(fps), bool)
         if len(fps) == 0 or self.is_empty():
             return found
+        # Injection seam: a real host probe can die on a torn spill
+        # file, a failing disk read, or a poisoned mmap — always before
+        # any result is applied, so a faulted probe never half-updates
+        # the wave's verdict.
+        fault_point("storage.host_probe", tenant=self._owner)
         stats: dict = {}
         hits = {"l1": 0, "l2": 0}
         bloom_probed = 0
@@ -488,6 +509,8 @@ class TenantPartitions:
                     self._prefix, registry=registry
                 ),
                 tracer=self._tracer,
+                # Chaos specs target one tenant's partition by this tag.
+                owner=tenant_key,
             )
             self._stores[tenant_key] = st
         return st

@@ -9,13 +9,27 @@ the reference gets from ``Ord``-by-hash.
 """
 
 from .dense_nat_map import DenseNatMap
+from .faults import (
+    FaultInjector,
+    FaultSpec,
+    classify_fault,
+    fault_point,
+    inject,
+    seeded_specs,
+)
 from .rewrite import RewritePlan, canonical_sort_key, rewrite_value
 from .vector_clock import VectorClock
 
 __all__ = [
     "DenseNatMap",
+    "FaultInjector",
+    "FaultSpec",
     "RewritePlan",
     "VectorClock",
     "canonical_sort_key",
+    "classify_fault",
+    "fault_point",
+    "inject",
     "rewrite_value",
+    "seeded_specs",
 ]

@@ -485,3 +485,54 @@ def test_http_front_end():
                 break
             time.sleep(0.05)
         assert st["state"] == "cancelled"
+
+
+@pytest.mark.parametrize(
+    "body,code,needle",
+    [
+        # Unknown zoo name: structured 400 naming the zoo.
+        ({"model": "not-a-model"}, 400, "unknown model"),
+        # Inadmissible budget: rejected at admission, not mid-run.
+        (
+            {"model": "2pc", "hbm_budget_mib": 0.0001},
+            400,
+            "rejected at admission",
+        ),
+        # Non-numeric deadline: coerced at submit, 400 with the reason.
+        ({"model": "2pc", "deadline_s": "soon"}, 400, "deadline_s"),
+        # Bad retry policy shape.
+        ({"model": "2pc", "retry": "always"}, 400, "retry"),
+        # Full admission queue: 429 + Retry-After (graceful
+        # degradation, not a client error).
+        ({"model": "2pc", "model_args": {"rm_count": 4}}, 429, "full"),
+    ],
+)
+def test_http_admission_errors_are_structured(body, code, needle):
+    """Every admission failure over HTTP is a structured JSON error
+    with the right status — including 429 for a full queue."""
+    with ServiceServer(
+        quantum_s=0.5,
+        default_spawn=dict(SPAWN_2PC),
+        max_queued_jobs=1,
+    ) as server:
+        filler = None
+        if code == 429:
+            # Occupy the single queue slot first.
+            filler = _http_json(
+                server.url + "/jobs",
+                json.dumps(
+                    {"model": "2pc", "model_args": {"rm_count": 4}}
+                ).encode(),
+            )
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _http_json(server.url + "/jobs", json.dumps(body).encode())
+        assert err.value.code == code
+        payload = json.loads(err.value.read().decode())
+        assert needle in payload["error"]
+        if code == 429:
+            assert err.value.headers.get("Retry-After") is not None
+            assert payload["retry_after_s"] > 0
+        if filler is not None:
+            _http_json(
+                f"{server.url}/jobs/{filler['job_id']}/cancel", b""
+            )
