@@ -59,6 +59,64 @@ def collect_reports(events):
     return reports
 
 
+def collect_liveness(events):
+    """The LAST ``<prefix>.liveness.summary`` instant per prefix — the
+    device-liveness ledger (edge-store occupancy + per-property
+    verdicts) rendered next to the coverage met-bit population."""
+    out = {}
+    for ev in events:
+        name = ev.get("name", "")
+        if not name.endswith(".liveness.summary"):
+            continue
+        args = ev.get("args") or {}
+        if isinstance(args.get("store"), dict):
+            out[name[: -len(".liveness.summary")]] = args
+    return out
+
+
+def print_liveness(prefix, rep, live, out=sys.stdout):
+    """The liveness block for one prefix: per-``eventually``-property
+    met-bit population (from the PR 8 coverage ledger) beside the
+    device verdict, plus edge-store occupancy."""
+    w = out.write
+    props = (rep or {}).get("properties") or {}
+    eventually = {
+        name: p
+        for name, p in props.items()
+        if p.get("expectation") == "eventually"
+    }
+    outcomes = (live or {}).get("outcomes") or {}
+    if not eventually and not live:
+        return
+    w(f"\n  liveness ({prefix})\n")
+    if eventually:
+        w(
+            f"  {'property':<32} {'met-bit pop':>11} "
+            f"{'device verdict':>16}\n"
+        )
+        w("  " + "-" * 62 + "\n")
+        for name, p in eventually.items():
+            o = outcomes.get(name) or {}
+            verdict = o.get("verdict", "-")
+            w(
+                f"  {name:<32} {p.get('exercised', 0):>11} "
+                f"{verdict:>16}\n"
+            )
+    store = (live or {}).get("store") or {}
+    if store:
+        w(
+            f"  edge store: {store.get('edges_logged', 0):,} edges "
+            f"logged, {store.get('evictions', 0)} evictions, "
+            f"{store.get('host_bytes', 0):,} host bytes"
+            + (
+                f", {store['spilled_chunks']} spilled chunks"
+                if store.get("spilled_chunks")
+                else ""
+            )
+            + f", analysis {live.get('analysis_s', 0):.2f}s\n"
+        )
+
+
 def _bar(n, peak, width=24):
     if not peak:
         return ""
@@ -180,26 +238,32 @@ def main(argv=None):
 
     events = load_events(args.trace)
     reports = collect_reports(events)
-    if not reports:
+    liveness = collect_liveness(events)
+    if not reports and not liveness:
         print(
-            f"no .coverage.summary instants in {args.trace} — was the "
-            "run spawned with coverage=True? (host engines always emit "
-            "them)",
+            f"no .coverage.summary or .liveness.summary instants in "
+            f"{args.trace} — was the run spawned with coverage=True or "
+            "liveness='device'? (host engines always emit coverage)",
             file=sys.stderr,
         )
         return 2
     vacuous = False
     if args.json:
-        json.dump(
-            dict(sorted(reports.items())), sys.stdout, indent=2,
-            sort_keys=True,
-        )
+        payload = dict(sorted(reports.items()))
+        for prefix, live in liveness.items():
+            payload[f"{prefix}.liveness"] = live
+        json.dump(payload, sys.stdout, indent=2, sort_keys=True)
         sys.stdout.write("\n")
         vacuous = any(r.get("vacuous") for r in reports.values())
     else:
         for prefix, rep in sorted(reports.items()):
             if print_report(prefix, rep):
                 vacuous = True
+            print_liveness(prefix, rep, liveness.get(prefix))
+        for prefix in sorted(set(liveness) - set(reports)):
+            # Liveness-mode runs without coverage=True still render
+            # their edge-store ledger.
+            print_liveness(prefix, None, liveness[prefix])
     if vacuous and not args.no_gate:
         print(
             "vacuity findings present (dead actions / unexercised "

@@ -82,6 +82,18 @@ def summarize(rec):
             1 for j in per_job if j.get("quarantined")
         ),
         "jobs_zero_compile": rec.get("jobs_zero_compile"),
+        # Liveness honesty (device-liveness PR): how each job's
+        # `eventually` verdicts were produced, and downgrades.
+        "liveness_modes": {
+            mode: sum(
+                1 for j in per_job if j.get("liveness_mode") == mode
+            )
+            for mode in ("device", "host_pass", "default")
+            if any(j.get("liveness_mode") == mode for j in per_job)
+        },
+        "liveness_downgraded": sum(
+            1 for j in per_job if j.get("liveness_reason")
+        ),
         "per_job": per_job,
     }
 
@@ -138,8 +150,18 @@ def render(summary, out=sys.stdout):
     w(
         f"  fault tolerance: {summary['faults_total'] or 0} faults, "
         f"{summary['retries_total'] or 0} retries, "
-        f"{summary['jobs_quarantined']} quarantined\n\n"
+        f"{summary['jobs_quarantined']} quarantined\n"
     )
+    modes = summary.get("liveness_modes") or {}
+    if modes:
+        rendered = ", ".join(f"{n} {m}" for m, n in modes.items())
+        downgraded = summary.get("liveness_downgraded") or 0
+        w(
+            f"  liveness: {rendered}"
+            + (f"; {downgraded} downgraded" if downgraded else "")
+            + "\n"
+        )
+    w("\n")
     header = (
         f"  {'job':<10} {'tenant':<10} {'ttfv_s':>8} {'wall_s':>8} "
         f"{'queued_s':>9} {'rate':>10} {'preempts':>8} {'slices':>6} "

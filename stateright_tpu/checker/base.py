@@ -339,6 +339,117 @@ class Checker(Generic[State, Action]):
         fingerprint map override this."""
         return list(self.discoveries())
 
+    # -- liveness surfaces (device mode + the host post-pass) ----------------
+
+    # Honest capability surface (the PR 12 pattern): True on backends
+    # whose ``liveness="device"`` spawn knob yields sound ``eventually``
+    # verdicts via the device edge store. The service exposes it per job
+    # so a downgrade to host-pass or default semantics is visible, not
+    # discovered from a TypeError at spawn.
+    supports_device_liveness = False
+    _live = None
+    _live_enabled = False
+    _live_store = None
+    _live_ins = None
+
+    @property
+    def liveness_mode(self) -> str:
+        """How this run's ``eventually`` verdicts were produced:
+        ``"device"`` (edge-store trim/reach — sound by construction),
+        ``"host_pass"`` (the opt-in O(region) post-pass), or
+        ``"default"`` (reference parity: the documented DAG-join/cycle
+        false negatives)."""
+        if getattr(self, "_live", None) == "device":
+            return "device"
+        if getattr(self, "_complete_liveness", False):
+            return "host_pass"
+        return "default"
+
+    def liveness_report(self) -> dict:
+        """The per-property liveness evidence the service surfaces:
+        mode, device verdicts/outcomes, host-pass inconclusive names,
+        edge-store stats, and whether a crashed run skipped the pass."""
+        out: dict = {"mode": self.liveness_mode}
+        outcomes = getattr(self, "_live_outcomes", None)
+        if outcomes:
+            out["outcomes"] = dict(outcomes)
+        store = getattr(self, "_live_store", None)
+        if store is not None:
+            out["edge_store"] = store.stats()
+        inconclusive = getattr(self, "_lasso_inconclusive", None)
+        if inconclusive:
+            out["inconclusive"] = sorted(inconclusive)
+        if getattr(self, "_liveness_skipped_crashed", False):
+            out["skipped_crashed_run"] = True
+        return out
+
+    def _with_device_liveness(self, out: Dict[str, Path]):
+        """Merges device-liveness counterexamples into ``out`` without
+        overriding default-semantics discoveries, and signals (once)
+        when a crashed run makes the missing verdicts untrustworthy —
+        a missing counterexample must never read as absence."""
+        if not getattr(self, "_live_enabled", False):
+            return out
+        for name, path in getattr(self, "_live_paths", {}).items():
+            out.setdefault(name, path)
+        if self.is_done() and self.worker_error() is not None:
+            self._signal_liveness_skip()
+        return out
+
+    def _flush_live_edges(self) -> None:
+        """Pre-analysis hook: backends with a device-resident edge
+        store drain it here; backends that absorb per wave need
+        nothing."""
+
+    def _run_liveness_analysis(self, prefix: str) -> None:
+        """End-of-exploration device-liveness pass, shared by the
+        device checkers (worker thread, so ``is_done()`` implies the
+        verdicts exist and a crash surfaces via ``worker_error``).
+        Preempted runs skip it — the edge store rides the checkpoint
+        payload and the resumed incarnation finishes the job."""
+        if not self._live_enabled or self._preempt_payload is not None:
+            return
+        if self._pipe is not None:
+            # Deferred edge absorbs must land before the store is read.
+            self._pipe.drain()
+        self._flush_live_edges()
+        import time as _time
+
+        from .device_liveness import analyze_liveness
+
+        t0 = _time.perf_counter()
+        with self._tracer.span(f"{prefix}.liveness.analysis"):
+            self._live_paths, self._live_outcomes = analyze_liveness(
+                self._model,
+                self._properties,
+                self._ebit,
+                self._live_store,
+                self._host_fp,
+                set(self._discoveries_fp),
+                instruments=self._live_ins,
+                tracer=self._tracer,
+            )
+        self._live_ins.analysis_seconds.set(_time.perf_counter() - t0)
+        # The PR 8 ledger surface coverage_report.py renders (edge-store
+        # occupancy next to the met-bit population).
+        self._tracer.instant(
+            f"{prefix}.liveness.summary",
+            store=self._live_store.stats(),
+            outcomes=self._live_outcomes,
+            analysis_s=_time.perf_counter() - t0,
+        )
+
+    def _signal_liveness_skip(self) -> None:
+        """Crashed-run skip evidence: the ``liveness.skipped_crashed_run``
+        counter plus a flag the reporter turns into a warning line."""
+        if getattr(self, "_liveness_skipped_crashed", False):
+            return
+        self._liveness_skipped_crashed = True
+        try:
+            self.metrics().counter("liveness.skipped_crashed_run").inc()
+        except Exception:  # noqa: BLE001 - signal, never a new failure
+            pass
+
     # -- complete-liveness plumbing (shared by every spawning checker) ------
 
     def _setup_lasso(self, options) -> None:
@@ -360,6 +471,15 @@ class Checker(Generic[State, Action]):
             )
         self._lassos: Optional[Dict[str, Path]] = None
         self._lasso_lock = threading.Lock()
+        # Bounded-pass knobs (builder) + the honest third outcome the
+        # bounded pass fills (see checker/liveness.py).
+        self._lasso_budget_states = getattr(
+            options, "_liveness_budget_states", None
+        )
+        self._lasso_deadline_s = getattr(
+            options, "_liveness_deadline_s", None
+        )
+        self._lasso_inconclusive: List[str] = []
 
     def _with_lassos(self, out: Dict[str, Path], done: bool, have):
         """Merges lasso counterexamples into ``out`` WITHOUT overriding
@@ -416,6 +536,15 @@ class Checker(Generic[State, Action]):
             poll()
         err = self.worker_error()
         if err is not None:
+            # Crashed run with a liveness pass armed: the pass was
+            # skipped, so absence of a counterexample proves nothing —
+            # say so before surfacing the crash (satellite of the
+            # device-liveness PR; never silent).
+            if getattr(self, "_complete_liveness", False) or getattr(
+                self, "_live_enabled", False
+            ):
+                self._signal_liveness_skip()
+                reporter.report_liveness(skipped_crashed=True)
             raise RuntimeError("checker worker thread failed") from err
 
         reporter.report_checking(
@@ -448,6 +577,12 @@ class Checker(Generic[State, Action]):
             ]
             if undiscovered:
                 reporter.report_undiscovered(undiscovered)
+            # Bounded host-pass honesty: the discoveries() call above
+            # already ran (and cached) the lasso pass, so the
+            # inconclusive set is final here.
+            inconclusive = getattr(self, "_lasso_inconclusive", None)
+            if inconclusive:
+                reporter.report_liveness(inconclusive=inconclusive)
         return self
 
     def discovery(self, name: str) -> Optional[Path]:

@@ -29,6 +29,8 @@ class CheckerBuilder:
         self._thread_count: int = 1
         self._visitor: Optional[CheckerVisitor] = None
         self._complete_liveness: bool = False
+        self._liveness_budget_states: Optional[int] = None
+        self._liveness_deadline_s: Optional[float] = None
 
     # -- configuration -----------------------------------------------------
 
@@ -42,7 +44,9 @@ class CheckerBuilder:
         self._symmetry = representative
         return self
 
-    def complete_liveness(self) -> "CheckerBuilder":
+    def complete_liveness(self, budget_states: Optional[int] = None,
+                          deadline_s: Optional[float] = None,
+                          ) -> "CheckerBuilder":
         """Opt-in cycle-aware ``eventually`` checking (beyond the
         reference, whose semantics miss counterexamples that loop —
         documented FIXMEs at ``src/checker/bfs.rs:285-305``): after
@@ -53,8 +57,19 @@ class CheckerBuilder:
         reference-exact. Honored by the exhaustive checkers
         (bfs/dfs/tpu_bfs/sharded_tpu_bfs), which refuse capped runs
         (``target_state_count``/``target_max_depth``) under this flag —
-        the lasso search cannot honor caps."""
+        the lasso search cannot honor caps.
+
+        ``budget_states`` / ``deadline_s`` bound the pass: properties it
+        cannot certify within the budget report an honest
+        ``inconclusive`` outcome (reporter line, ``liveness.inconclusive``
+        metric, ``liveness_report()``) instead of stalling
+        ``discoveries()`` for unbounded host minutes. For sound verdicts
+        WITHOUT the O(region) cost, prefer the device checkers'
+        ``liveness="device"`` spawn knob (README "Trustworthy
+        liveness")."""
         self._complete_liveness = True
+        self._liveness_budget_states = budget_states
+        self._liveness_deadline_s = deadline_s
         return self
 
     def target_state_count(self, count: int) -> "CheckerBuilder":

@@ -1,0 +1,335 @@
+"""Device-native ``eventually`` soundness: edge logging + verdict + certificate.
+
+The default device semantics reproduce the reference's documented false
+negatives (``checker/liveness.py``): ``eventually`` bits merge at DAG
+joins and cycles are invisible to a BFS that stores tree edges only. The
+host post-pass fixes that at O(condition-false region) single-threaded
+cost — minutes at raft-5 scale. ``liveness="device"`` replaces it with a
+three-stage device-native procedure:
+
+1. **Log** (in the wave jits, :func:`wave_edge_rows`): per eventually
+   property, every (parent, child) transition whose BOTH endpoints fail
+   the condition, plus condition-false terminal states and
+   condition-false init states (roots). Appended to the capacity-budgeted
+   device store (``ops/edge_store.py``), evicted to the host tier
+   (``storage/edge_log.py``) when over budget.
+
+2. **Decide** (:func:`analyze_liveness`): a counterexample exists iff the
+   condition-false subgraph, restricted to states reachable from a
+   condition-false init through condition-false states only, contains a
+   cycle (lasso shape) or a terminal state (masked-terminal shape). The
+   cycle half is the vmapped iterative-trim kernel (non-empty fixed
+   point ⟺ a cycle exists among the logged edges); the restriction is
+   the root-reachability kernel, run only when candidates exist — the
+   absence verdict normally needs the trim alone, which is what makes
+   absence certification cheap. Equivalence with the host pass
+   (``find_eventually_lasso``): both decide "∃ maximal condition-false
+   path from a condition-false init", whose finite-space shapes are
+   exactly {reachable cycle, reachable terminal}.
+
+3. **Certify**: a concrete :class:`~..core.path.Path` is extracted from
+   the LOGGED edges — a deterministic BFS from the roots to the first
+   candidate (shortest condition-false prefix), extended around the
+   cycle by walking surviving successors when the candidate is a trim
+   survivor — then replayed through the host model
+   (``Path.from_fingerprints``), i.e. the existing host machinery seeded
+   from the surviving fingerprint's state instead of searching from
+   scratch.
+
+Duplicate edges (table-growth retries re-expand a frontier) dedup in the
+host store, so verdicts and certificates are independent of retry
+timing, packing, and async pipelining — the bit-identity argument the
+equivalence tests pin.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.model import Expectation
+from ..core.path import Path
+
+__all__ = [
+    "wave_edge_rows",
+    "seed_root_mask",
+    "analyze_liveness",
+    "LIVENESS_MODES",
+]
+
+# The spawn-knob vocabulary, shared by checkers and the service gate.
+LIVENESS_MODES = (None, "default", "device")
+
+
+def validate_liveness_mode(liveness, *, symmetry: bool, expand_fps,
+                           options) -> Optional[str]:
+    """Normalizes and validates the ``liveness=`` spawn knob for a
+    device checker; returns ``"device"`` or ``None``. Raises on
+    configurations whose edge relation would be incomplete (the verdict
+    would silently lose soundness — refusing is the honest move)."""
+    if liveness not in LIVENESS_MODES:
+        raise ValueError(
+            f"liveness must be one of {LIVENESS_MODES}, got {liveness!r}"
+        )
+    if liveness != "device":
+        return None
+    if symmetry:
+        raise ValueError(
+            "liveness='device' is incompatible with symmetry reduction: "
+            "orbit-deduped states are never re-expanded, so the logged "
+            "edge relation would miss their outgoing transitions and "
+            "the cycle verdict would be unsound; use the host post-pass "
+            "(.complete_liveness()) under symmetry"
+        )
+    if expand_fps:
+        raise ValueError(
+            "liveness='device' is incompatible with expand_fps=True: "
+            "the fingerprint-only wave never materializes candidate "
+            "states, so child condition values cannot be evaluated "
+            "in-wave; drop expand_fps (device liveness forces the "
+            "materializing wave)"
+        )
+    if (
+        options._target_state_count is not None
+        or options._target_max_depth is not None
+    ):
+        raise ValueError(
+            "liveness='device' requires an uncapped run: a capped "
+            "exploration logs a truncated edge relation, and a verdict "
+            "over it could certify absence that a deeper run refutes"
+        )
+    return "device"
+
+
+def wave_edge_rows(conditions, ebit: Dict[int, int], cond_vals, cand_flat,
+                   cvalid_flat, terminal, hi, lo, chi, clo, A: int,
+                   extra_lane=None, extra_row=None):
+    """Traced inside a wave jit: the wave's condition-false edge and
+    terminal rows, prefix-compacted into (B + F)-wide u32 columns
+    (edges first, then terminal rows with the (0, 0) child sentinel).
+    ``extra_lane``/``extra_row`` add per-lane (B-wide) / per-frontier-row
+    (F-wide) int32 columns — the packed engine threads the tenant id
+    through. Returns ``(rows, n)``."""
+    B = cvalid_flat.shape[0]
+    F = hi.shape[0]
+    lanes = jnp.arange(B, dtype=jnp.int32)
+    prow = lanes // A
+    emask = jnp.zeros((B,), jnp.uint32)
+    tmask = jnp.zeros((F,), jnp.uint32)
+    for pi, b in ebit.items():
+        pfalse = ~cond_vals[pi]
+        cc = jax.vmap(conditions[pi])(cand_flat)
+        ebit_lane = cvalid_flat & pfalse[prow] & ~cc
+        emask = emask | jnp.where(
+            ebit_lane, jnp.uint32(1 << b), jnp.uint32(0)
+        )
+        tbit = terminal & pfalse
+        tmask = tmask | jnp.where(
+            tbit, jnp.uint32(1 << b), jnp.uint32(0)
+        )
+    sel_e = emask != 0
+    sel_t = tmask != 0
+    n_e = sel_e.sum(dtype=jnp.int32)
+    n_t = sel_t.sum(dtype=jnp.int32)
+    width = B + F
+    pos_e = jnp.cumsum(sel_e.astype(jnp.int32)) - 1
+    pos_t = n_e + jnp.cumsum(sel_t.astype(jnp.int32)) - 1
+    slot_e = jnp.where(sel_e, pos_e, width)
+    slot_t = jnp.where(sel_t, pos_t, width)
+    zu = jnp.zeros((width,), jnp.uint32)
+
+    def scat(dst, idx, vals):
+        return dst.at[idx].set(vals, mode="drop")
+
+    rows = {
+        "phi": scat(scat(zu, slot_e, hi[prow]), slot_t, hi),
+        "plo": scat(scat(zu, slot_e, lo[prow]), slot_t, lo),
+        "chi": scat(zu, slot_e, chi),
+        "clo": scat(zu, slot_e, clo),
+        "emask": scat(zu, slot_e, emask),
+        "tmask": scat(zu, slot_t, tmask),
+    }
+    zi = jnp.zeros((width,), jnp.int32)
+    for name, col in (extra_lane or {}).items():
+        rows[name] = scat(zi, slot_e, col)
+    for name, col in (extra_row or {}).items():
+        rows[name] = scat(rows.get(name, zi), slot_t, col)
+    return rows, n_e + n_t
+
+
+def seed_root_mask(conditions, ebit: Dict[int, int], states, valid):
+    """Traced in the seed jit: the per-init-lane u32 mask of eventually
+    properties whose condition is FALSE at that (valid) init state —
+    the analysis roots."""
+    n0 = valid.shape[0]
+    mask = jnp.zeros((n0,), jnp.uint32)
+    for pi, b in ebit.items():
+        false_here = valid & ~jax.vmap(conditions[pi])(states)
+        mask = mask | jnp.where(
+            false_here, jnp.uint32(1 << b), jnp.uint32(0)
+        )
+    return mask
+
+
+# -- analysis ----------------------------------------------------------------
+
+
+def _certificate_fps(src_idx, dst_idx, roots_idx, cand_mask, alive,
+                     nodes) -> np.ndarray:
+    """Deterministic certificate extraction over the logged edges:
+    BFS (sorted adjacency, sorted root seed order) from the roots to the
+    first candidate; a trim-surviving candidate is extended around its
+    cycle by always walking the smallest surviving successor. Returns
+    the fingerprint trail (u64)."""
+    from collections import deque
+
+    N = len(nodes)
+    order = np.lexsort((dst_idx, src_idx))
+    s_sorted = src_idx[order]
+    d_sorted = dst_idx[order]
+    starts = np.searchsorted(s_sorted, np.arange(N + 1))
+    pred = np.full((N,), -1, np.int64)
+    seen = np.zeros((N,), bool)
+    q = deque()
+    for r in sorted(roots_idx):
+        if not seen[r]:
+            seen[r] = True
+            q.append(int(r))
+    found = -1
+    while q:
+        v = q.popleft()
+        if cand_mask[v]:
+            found = v
+            break
+        for u in d_sorted[starts[v]:starts[v + 1]]:
+            u = int(u)
+            if not seen[u]:
+                seen[u] = True
+                pred[u] = v
+                q.append(u)
+    assert found >= 0, "certificate extraction: no candidate reachable"
+    trail = [found]
+    while pred[trail[-1]] >= 0:
+        trail.append(int(pred[trail[-1]]))
+    trail.reverse()
+    if alive[found]:
+        # Lasso: extend around the cycle — each survivor keeps at least
+        # one surviving successor (the trim fixed-point invariant).
+        on_walk = {found}
+        cur = found
+        while True:
+            succs = d_sorted[starts[cur]:starts[cur + 1]]
+            succs = [int(u) for u in succs if alive[u]]
+            assert succs, "trim fixed point lost its successor"
+            nxt = min(succs)
+            trail.append(nxt)
+            if nxt in on_walk:
+                break
+            on_walk.add(nxt)
+            cur = nxt
+    return nodes[np.asarray(trail, np.int64)]
+
+
+def analyze_liveness(model, properties, ebit: Dict[int, int], store,
+                     fp_of, have, instruments=None, tracer=None,
+                     ) -> Tuple[Dict[str, Path], Dict[str, dict]]:
+    """End-of-exploration device-liveness pass: one verdict per
+    still-undiscovered ``eventually`` property. Returns
+    ``(paths, outcomes)`` where ``outcomes[name]`` records the verdict
+    (``"counterexample"`` / ``"absent"``) and the analysis evidence
+    (edge/node counts, trim rounds, seconds)."""
+    from ..ops.edge_store import lasso_trim, reach_any
+
+    paths: Dict[str, Path] = {}
+    outcomes: Dict[str, dict] = {}
+    # One spill re-read + full-relation dedup for the whole pass: the
+    # relation is property-independent; only the per-row mask bit
+    # differs, and property_slice slices it from this shared view.
+    all_rows = None
+    for pi, prop in enumerate(properties):
+        if prop.expectation != Expectation.EVENTUALLY:
+            continue
+        if prop.name in have:
+            outcomes[prop.name] = {"verdict": "already_discovered"}
+            continue
+        b = ebit[pi]
+        t0 = time.perf_counter()
+        if all_rows is None:
+            all_rows = store.edge_rows()
+        src64, dst64, roots64, terms64 = store.property_slice(
+            b, rows=all_rows
+        )
+        record = {
+            "verdict": "absent",
+            "edges": int(len(src64)),
+            "roots": int(len(roots64)),
+            "terminals": int(len(terms64)),
+            "trim_rounds": 0,
+            "survivors": 0,
+        }
+        if len(roots64) == 0:
+            # Every init satisfies the condition already — every path
+            # satisfies the property at step 0.
+            record["seconds"] = time.perf_counter() - t0
+            outcomes[prop.name] = record
+            _count(instruments, record)
+            continue
+        nodes = np.unique(
+            np.concatenate([roots64, terms64, src64, dst64])
+        )
+        N = len(nodes)
+        src_idx = np.searchsorted(nodes, src64).astype(np.int32)
+        dst_idx = np.searchsorted(nodes, dst64).astype(np.int32)
+        evalid = np.ones((len(src_idx),), bool)
+        nvalid = np.ones((N,), bool)
+        record["nodes"] = N
+        alive = np.zeros((N,), bool)
+        if len(src_idx):
+            alive, rounds = lasso_trim(src_idx, dst_idx, evalid, nvalid)
+            record["trim_rounds"] = rounds
+            record["survivors"] = int(alive.sum())
+        term_mask = np.zeros((N,), bool)
+        term_mask[np.searchsorted(nodes, terms64)] = True
+        cand = alive | term_mask
+        if cand.any():
+            roots_idx = np.searchsorted(nodes, roots64)
+            roots_mask = np.zeros((N,), bool)
+            roots_mask[roots_idx] = True
+            hit, _reach = reach_any(
+                src_idx, dst_idx, evalid, roots_mask, cand
+            )
+            if hit:
+                fps = _certificate_fps(
+                    src_idx, dst_idx, roots_idx, cand, alive, nodes
+                )
+                paths[prop.name] = Path.from_fingerprints(
+                    model, [int(f) for f in fps], fp_of=fp_of
+                )
+                record["verdict"] = "counterexample"
+                record["certificate_len"] = int(len(fps))
+        record["seconds"] = time.perf_counter() - t0
+        outcomes[prop.name] = record
+        _count(instruments, record)
+        if tracer is not None:
+            tracer.instant(
+                "liveness.verdict", property=prop.name, **{
+                    k: v for k, v in record.items() if k != "verdict"
+                }, verdict=record["verdict"],
+            )
+    return paths, outcomes
+
+
+def _count(instruments, record) -> None:
+    if instruments is None:
+        return
+    instruments.trim_rounds.inc(record.get("trim_rounds", 0))
+    if record["verdict"] == "counterexample":
+        instruments.counterexamples.inc()
+    elif record["verdict"] == "absent":
+        instruments.absences.inc()
+    if "seconds" in record:
+        instruments.analysis_seconds.set(record["seconds"])

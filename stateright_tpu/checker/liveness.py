@@ -58,19 +58,36 @@ and cycle detection needs the full edge relation.
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional
+import time
+from typing import Dict, List, Optional, Tuple
 
 from ..core.model import Expectation, Property
 from ..core.path import Path
 
 __all__ = [
+    "INCONCLUSIVE",
     "find_eventually_lasso",
     "lasso_discoveries",
+    "lasso_discoveries_ex",
     "checker_lasso_pass",
 ]
 
 
-def find_eventually_lasso(model, prop: Property) -> Optional[Path]:
+class _Inconclusive:
+    """Sentinel: the pass ran out of its state budget or deadline before
+    it could certify either way. Distinct from None (= absence
+    certified) because conflating them would turn an aborted search
+    into a silent 'property holds'."""
+
+    def __repr__(self):  # pragma: no cover - debugging nicety
+        return "INCONCLUSIVE"
+
+
+INCONCLUSIVE = _Inconclusive()
+
+
+def find_eventually_lasso(model, prop: Property, budget_states=None,
+                          deadline_s=None) -> Optional[Path]:
     """A counterexample for one ``eventually`` property, or None.
 
     Iterative DFS over the condition-false region with white/gray/black
@@ -82,8 +99,33 @@ def find_eventually_lasso(model, prop: Property) -> Optional[Path]:
     state whose successors all satisfy the condition is neither: every
     maximal path through it satisfies the property. States must be
     hashable (the host checkers' standing requirement).
+
+    ``budget_states`` / ``deadline_s`` bound the search: when either is
+    exhausted before a certificate or a full region exhaust, the pass
+    returns :data:`INCONCLUSIVE` — an HONEST third outcome, never
+    conflated with "no counterexample" (None). This is what keeps an
+    opted-in raft-5-scale run from stalling ``discoveries()`` for
+    unbounded host minutes.
     """
     cond = prop.condition
+    deadline_t = (
+        time.monotonic() + deadline_s if deadline_s is not None else None
+    )
+    expanded = 0
+    # Deadline polls are batched (every 256 expansions) so the budget
+    # machinery costs nothing against the per-state model expansion.
+    _POLL = 256
+
+    def over_budget() -> bool:
+        nonlocal expanded
+        expanded += 1
+        if budget_states is not None and expanded > budget_states:
+            return True
+        return (
+            deadline_t is not None
+            and expanded % _POLL == 0
+            and time.monotonic() > deadline_t
+        )
 
     def expand(state):
         """(had_any_successor, condition-false successors). The first
@@ -111,6 +153,8 @@ def find_eventually_lasso(model, prop: Property) -> Optional[Path]:
         if color.get(init, WHITE) != WHITE:
             continue
         color[init] = GRAY
+        if over_budget():
+            return INCONCLUSIVE
         any_within, succs = expand(init)
         if not any_within:
             # Terminal condition-false init: a one-state maximal path.
@@ -132,6 +176,8 @@ def find_eventually_lasso(model, prop: Property) -> Optional[Path]:
                     return Path(steps)
                 if c == WHITE:
                     color[nxt] = GRAY
+                    if over_budget():
+                        return INCONCLUSIVE
                     any_within, nsuccs = expand(nxt)
                     if not any_within:
                         # Terminal condition-false state: trail + the
@@ -162,35 +208,88 @@ def checker_lasso_pass(checker, done: bool, have) -> Dict[str, Path]:
     opt-in flag is set AND exploration finished cleanly — a crashed run
     must not launch an unbounded host DFS from ``discoveries()`` (callers
     often inspect a failed checker), nor report counterexamples for a run
-    that never completed. ``have`` is the checker's existing
-    discovery-name collection (terminal-state counterexamples win)."""
+    that never completed. A crashed run's skip is SIGNALED
+    (``liveness.skipped_crashed_run`` counter + reporter warning via
+    ``Checker._signal_liveness_skip``), never silent — ``{}`` from a
+    crashed run must not read as "no counterexample exists". ``have`` is
+    the checker's existing discovery-name collection (terminal-state
+    counterexamples win). Budget knobs
+    (``.complete_liveness(budget_states=, deadline_s=)``) bound the pass;
+    properties it could not certify land in
+    ``checker._lasso_inconclusive`` and the ``liveness.inconclusive``
+    metric instead of stalling the caller for unbounded host minutes."""
     if not checker._complete_liveness or not done:
         return {}
     if checker.worker_error() is not None:
+        checker._signal_liveness_skip()
         return {}
     with checker._lasso_lock:
         if checker._lassos is None:
             props = getattr(checker, "_properties", None)
             if props is None:
                 props = checker._model.properties()
-            checker._lassos = lasso_discoveries(
-                checker._model, props, set(have)
+            paths, inconclusive = lasso_discoveries_ex(
+                checker._model,
+                props,
+                set(have),
+                budget_states=getattr(
+                    checker, "_lasso_budget_states", None
+                ),
+                deadline_s=getattr(checker, "_lasso_deadline_s", None),
             )
+            checker._lasso_inconclusive = inconclusive
+            if inconclusive:
+                try:
+                    reg = checker.metrics()
+                    reg.counter("liveness.inconclusive").inc(
+                        len(inconclusive)
+                    )
+                except Exception:  # noqa: BLE001 - signal only
+                    pass
+            checker._lassos = paths
     return checker._lassos
 
 
-def lasso_discoveries(model, properties, have) -> Dict[str, Path]:
+def lasso_discoveries(model, properties, have, budget_states=None,
+                      deadline_s=None) -> Dict[str, Path]:
     """Counterexamples (lasso or masked-terminal maximal path) for every
     undiscovered ``eventually`` property. ``have`` is the checker's
     existing discovery-name set (first-found wins; counterexamples the
     default semantics already reported stay as-is)."""
+    return lasso_discoveries_ex(
+        model, properties, have, budget_states=budget_states,
+        deadline_s=deadline_s,
+    )[0]
+
+
+def lasso_discoveries_ex(model, properties, have, budget_states=None,
+                         deadline_s=None,
+                         ) -> Tuple[Dict[str, Path], List[str]]:
+    """``lasso_discoveries`` plus the honest third outcome: the names
+    the bounded pass could NOT certify (budget or deadline exhausted).
+    The deadline is shared across properties — one runaway region must
+    not starve the rest AND still overrun the caller's bound."""
+    deadline_t = (
+        time.monotonic() + deadline_s if deadline_s is not None else None
+    )
     out: Dict[str, Path] = {}
+    inconclusive: List[str] = []
     for prop in properties:
         if prop.expectation != Expectation.EVENTUALLY:
             continue
         if prop.name in have:
             continue
-        path = find_eventually_lasso(model, prop)
-        if path is not None:
+        remaining = (
+            max(0.001, deadline_t - time.monotonic())
+            if deadline_t is not None
+            else None
+        )
+        path = find_eventually_lasso(
+            model, prop, budget_states=budget_states,
+            deadline_s=remaining,
+        )
+        if path is INCONCLUSIVE:
+            inconclusive.append(prop.name)
+        elif path is not None:
             out[prop.name] = path
-    return out
+    return out, inconclusive

@@ -200,6 +200,13 @@ class _Tenant:
         self.fault_error: Optional[BaseException] = None
         self.compile_offset = 0.0
         self.view: Optional["TenantRun"] = None
+        # Device liveness (engine liveness="device"): this tenant's own
+        # condition-false edge partition + the finish-time verdicts.
+        # Absorbs are idempotent facts about the state graph (the store
+        # dedups), so fault rollback never needs to undo them.
+        self.live_store = None
+        self.live_paths: Dict[str, "Path"] = {}
+        self.live_outcomes: Dict[str, dict] = {}
 
     def ingest(self) -> None:
         with self._ingest_lock:
@@ -223,6 +230,23 @@ class TenantRun(Checker):
         self.run_id = tenant.run_id
         self._registry = tenant.registry
         self.warmup_seconds = 0.0
+        # Liveness surfaces (checker/base.py) read these per tenant.
+        self._live = engine._live
+        self._live_enabled = engine._live_enabled
+
+    supports_device_liveness = True
+
+    @property
+    def _live_store(self):
+        return self._t.live_store
+
+    @property
+    def _live_paths(self):
+        return self._t.live_paths
+
+    @property
+    def _live_outcomes(self):
+        return self._t.live_outcomes
 
     def model(self):
         return self._engine._model
@@ -237,13 +261,14 @@ class TenantRun(Checker):
         return self._t.max_depth
 
     def discoveries(self) -> Dict[str, Path]:
-        return {
+        out = {
             name: self._reconstruct(fp)
             for name, fp in list(self._t.discoveries_fp.items())
         }
+        return self._with_device_liveness(out)
 
     def _discovery_names(self) -> List[str]:
-        return list(self._t.discoveries_fp)
+        return list(set(self._t.discoveries_fp) | set(self._t.live_paths))
 
     def _reconstruct(self, fp: int) -> Path:
         self._t.ingest()
@@ -301,6 +326,7 @@ class TenantPackedEngine:
         aot_cache: Optional[str] = None,
         resume_capacity: Optional[int] = None,
         run_id: Optional[str] = None,
+        liveness=None,
     ):
         if not isinstance(model, BatchableModel):
             raise TypeError(
@@ -325,6 +351,23 @@ class TenantPackedEngine:
             raise ValueError("at most 32 eventually properties supported")
         self._ebit = {pi: b for b, pi in enumerate(eventually)}
         self._ebits0 = sum(1 << b for b in self._ebit.values())
+        # Device-native liveness, packed: the wave logs each lane's
+        # condition-false edges with its tenant id, and the verdict
+        # splits them into PER-TENANT host edge partitions — fps are
+        # the ORIGINAL (pre-salt) ones (chi/clo are computed before
+        # ``hashset_insert_salted`` applies the XOR), so each tenant's
+        # relation is bit-identical to its solo run's and the per-tenant
+        # trim/reach verdict at finish time matches the solo verdict
+        # exactly (tests/test_device_liveness.py).
+        from .device_liveness import LIVENESS_MODES
+
+        if liveness not in LIVENESS_MODES:
+            raise ValueError(
+                f"liveness must be one of {LIVENESS_MODES}, "
+                f"got {liveness!r}"
+            )
+        self._live = "device" if liveness == "device" else None
+        self._live_enabled = self._live == "device" and bool(self._ebit)
         self._A = model.packed_action_count()
         self._fp_fn = model.packed_fingerprint
         self._K = max(1, int(max_tenants))
@@ -431,6 +474,7 @@ class TenantPackedEngine:
             self._F_max,
             tuple(self._buckets),
             self._max_capacity,
+            self._live_enabled,
         )
 
     def _host_fp(self, host_state) -> int:
@@ -520,6 +564,19 @@ class TenantPackedEngine:
         }
 
         out = {"table": table, "new": new}
+        if self._live_enabled:
+            # Per-tenant condition-false edge rows (ORIGINAL fps — the
+            # salt never touches chi/clo), tenant id riding each row so
+            # the verdict can split them into per-tenant partitions.
+            from .device_liveness import wave_edge_rows
+
+            live_rows, live_n = wave_edge_rows(
+                self._conditions, self._ebit, cond_vals, cand_flat,
+                cvalid_flat, terminal, hi, lo, chi, clo, A,
+                extra_lane={"tid": ctid}, extra_row={"tid": tid},
+            )
+            out["live"] = live_rows
+            out["live_n"] = live_n
         # Per-(tenant, property) discovery scan over the evaluated
         # frontier — argmax picks the tenant's FIRST hit in lane order,
         # which is its first hit in its own FIFO order.
@@ -551,9 +608,10 @@ class TenantPackedEngine:
             stats.append(out["prop_hit"].any().astype(jnp.int32))
         else:
             stats.append(jnp.int32(0))
-        out["stats"] = jnp.concatenate(
-            [jnp.stack(stats), gen_t, new_t, maxd_t]
-        )
+        cols = [jnp.stack(stats), gen_t, new_t, maxd_t]
+        if self._live_enabled:
+            cols.append(out["live_n"][None].astype(jnp.int32))
+        out["stats"] = jnp.concatenate(cols)
         return out
 
     def _seed_wave(self, table, salt_hi, salt_lo):
@@ -573,7 +631,7 @@ class TenantPackedEngine:
             jnp.full((n0,), salt_lo, jnp.uint32),
             valid,
         )
-        return {
+        out = {
             "table": table,
             "states": states,
             "valid": valid,
@@ -583,6 +641,13 @@ class TenantPackedEngine:
             "n_valid": valid.sum(dtype=jnp.int32),
             "overflow": pending.sum(dtype=jnp.int32),
         }
+        if self._live_enabled:
+            from .device_liveness import seed_root_mask
+
+            out["root_mask"] = seed_root_mask(
+                self._conditions, self._ebit, states, valid
+            )
+        return out
 
     def _bulk_insert(self, table, hi, lo, salt_hi, salt_lo, active):
         """Fixed-width salted claim batch (resume admission)."""
@@ -652,6 +717,12 @@ class TenantPackedEngine:
         tenants can never alias it."""
         if key in self._by_key:
             raise ValueError(f"tenant {key!r} is already packed")
+        if depth_cap is not None and self._live_enabled:
+            raise ValueError(
+                "liveness='device' packs cannot admit depth-capped "
+                "tenants: a capped exploration logs a truncated edge "
+                "relation, so the finish-time verdict would be unsound"
+            )
         slot = next(
             (i for i, s in enumerate(self._slots) if s is None), None
         )
@@ -732,6 +803,10 @@ class TenantPackedEngine:
         t.unique_count = int(len(np.unique(child64)))
         t.wave_log.append((child64, np.zeros_like(child64)))
         t.resident.append(np.unique(child64))
+        if self._live_enabled:
+            self._live_tenant_store(t).add_roots(
+                child64, np.asarray(out["root_mask"])[valid]
+            )
         states_np = jax.tree_util.tree_map(np.asarray, out["states"])
         n_live = int(valid.sum())
         block = {
@@ -769,6 +844,23 @@ class TenantPackedEngine:
             store.load_state(storage_state)
             keys = keys[~store.probe(keys)]
         t.resident.append(keys)
+        # Liveness edge partition must round-trip with the tenant (see
+        # checker/tpu.py for why mode mismatches are refused).
+        live_state = payload.get("liveness")
+        if self._live_enabled and live_state is None:
+            raise ValueError(
+                "liveness='device' packs cannot admit a payload written "
+                "without it: pre-checkpoint edges were never logged, so "
+                "the finish-time verdict would be unsound"
+            )
+        if live_state is not None:
+            if not self._live_enabled:
+                raise ValueError(
+                    "payload carries a liveness edge store; admit into "
+                    "a liveness='device' pack (or resume solo with "
+                    "liveness='device')"
+                )
+            self._live_tenant_store(t).load_state(live_state)
         # Bulk-claim the tenant's known keys under its fresh salt.
         hi = (keys >> np.uint64(32)).astype(np.uint32)
         lo = (keys & np.uint64(0xFFFFFFFF)).astype(np.uint32)
@@ -897,6 +989,11 @@ class TenantPackedEngine:
         store = self._partitions.get(t.key)
         if store is not None and not store.is_empty():
             payload["storage"] = store.export_state()
+        if self._live_enabled:
+            payload["liveness"] = self._live_tenant_store(
+                t
+            ).export_state()
+            payload["version"] = 3
         return payload
 
     def _finish_view(self, t: _Tenant) -> None:
@@ -1261,6 +1358,9 @@ class TenantPackedEngine:
                 gen_t=gen_t if attempt == 0 else np.zeros((K,), np.int64),
                 width=width,
                 lanes_by_slot=lanes_by_slot if attempt == 0 else {},
+                live_n=(
+                    int(stats[2 + 3 * K]) if self._live_enabled else 0
+                ),
             )
             if self._pipe is None:
                 try:
@@ -1330,6 +1430,15 @@ class TenantPackedEngine:
         n_total = ticket["n_total"]
         out = ticket["out"]
         width = ticket["width"]
+        live_cols = live_tid = None
+        if self._live_enabled and ticket.get("live_n"):
+            from ..ops.edge_store import EDGE_COLS
+
+            nlive = ticket["live_n"]
+            live_cols = {
+                c: np.asarray(out["live"][c])[:nlive] for c in EDGE_COLS
+            }
+            live_tid = np.asarray(out["live"]["tid"])[:nlive]
         if n_total:
             new = out["new"]
             hi = np.asarray(new["hi"])[:n_total]
@@ -1355,6 +1464,21 @@ class TenantPackedEngine:
             survivors = 0
             stale = 0
             try:
+                if live_cols is not None and not t.done:
+                    # This tenant's slice of the wave's edge rows into
+                    # its own partition — inside the per-tenant try, so
+                    # an absorb fault (the liveness.edge_evict seam)
+                    # lane-drops only this tenant (pack-local blast
+                    # radius; absorbs are idempotent, so the rolled-back
+                    # tenant's retry re-absorbing them is harmless).
+                    lsel = np.flatnonzero(live_tid == k)
+                    if len(lsel):
+                        self._live_tenant_store(t).absorb(
+                            **{
+                                c: live_cols[c][lsel]
+                                for c in live_cols
+                            }
+                        )
                 if n_k and not t.done:
                     # Injection seam: one tenant's host-tier verdict
                     # slice dies (its probe, its numpy, its partition)
@@ -1423,6 +1547,43 @@ class TenantPackedEngine:
         if deferred is not None:
             raise deferred
 
+    def _live_tenant_store(self, t: _Tenant):
+        """The tenant's lazily-created liveness edge partition (its own
+        store — per-tenant partitions mirror storage.TenantPartitions,
+        and the owner tag routes the fault seam's tenant filter)."""
+        if t.live_store is None:
+            from ..storage import LivenessEdgeStore, LivenessInstruments
+
+            t.live_store = LivenessEdgeStore(
+                instruments=LivenessInstruments(
+                    "pack", registry=t.registry
+                ),
+                owner=t.key,
+            )
+        return t.live_store
+
+    def _tenant_liveness(self, t: _Tenant) -> None:
+        """Finish-time per-tenant device-liveness verdict: the shared
+        trim/reach pass over THIS tenant's edge partition (unsalted
+        fps), so the packed verdict is exactly the solo run's."""
+        from .device_liveness import analyze_liveness
+
+        t.live_paths, t.live_outcomes = analyze_liveness(
+            self._model,
+            self._properties,
+            self._ebit,
+            self._live_tenant_store(t),
+            self._host_fp,
+            set(t.discoveries_fp),
+            tracer=self._tracer,
+        )
+        self._tracer.instant(
+            "pack.tenant_liveness", tenant=str(t.key),
+            verdicts={
+                k: v.get("verdict") for k, v in t.live_outcomes.items()
+            },
+        )
+
     def _ensure_capacity(self, incoming: int) -> None:
         need = self._l0 + incoming
         if need <= _MAX_LOAD * self._capacity:
@@ -1458,6 +1619,11 @@ class TenantPackedEngine:
         finished = []
         for t in candidates:
             t.done = True
+            if self._live_enabled and not t.faulted:
+                # The tenant's exploration is complete: decide its
+                # `eventually` verdicts before is_done() can observe
+                # the finish (the service finalizes right after).
+                self._tenant_liveness(t)
             t.finished = True
             t.lanes.clear()
             self._finish_view(t)

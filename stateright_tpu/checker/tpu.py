@@ -135,8 +135,12 @@ def checkpoint_header(
         sym_scheme = SYM_KEY_SCHEME
     return {
         # v2 (out-of-core tiering): adds the optional "storage" payload
-        # (L1/L2 fingerprint runs + Bloom filters). v1 checkpoints (no
-        # storage tier by construction) still restore; see MIGRATING.md.
+        # (L1/L2 fingerprint runs + Bloom filters). v3 (device
+        # liveness): adds the optional "liveness" payload (the
+        # condition-false edge store + roots/terminals) — writers stamp
+        # 3 only when that payload is present, so v2 readers keep
+        # restoring every checkpoint written without liveness="device".
+        # v1/v2 checkpoints still restore; see MIGRATING.md.
         "version": 2,
         "kind": kind,
         "model": type(model).__name__,
@@ -159,7 +163,7 @@ def validate_checkpoint_header(
     """Rejects checkpoints another checker kind, model, model configuration,
     or symmetry setting wrote. Checkpoints predating the ``kind`` field were
     written by the single-device checker (the only kind that existed)."""
-    if payload.get("version") not in (1, 2):
+    if payload.get("version") not in (1, 2, 3):
         raise ValueError(f"unsupported checkpoint version: {payload!r}")
     found_kind = payload.get("kind", "tpu_bfs")
     if found_kind != kind:
@@ -548,6 +552,8 @@ class TpuBfsChecker(Checker):
         run_id=None,
         aot_cache=None,
         async_pipeline=False,
+        liveness=None,
+        edge_log_capacity=None,
     ):
         model = options.model
         if not isinstance(model, BatchableModel):
@@ -825,6 +831,58 @@ class TpuBfsChecker(Checker):
             self._use_fps = True
         else:
             self._use_fps = False
+        # Device-native liveness (``liveness="device"``, README
+        # "Trustworthy liveness"): the wave jits log the condition-false
+        # edge relation per ``eventually`` property into a
+        # capacity-budgeted device store (ops/edge_store.py; evicted to
+        # storage/edge_log.py when over budget), and a run-end
+        # trim+reach pass decides lasso/masked-terminal existence with a
+        # concrete certificate — closing the reference's documented
+        # false negative without the O(region) host post-pass. Forces
+        # the materializing wave (child conditions need candidate
+        # states), which the expand_fps resolution above already
+        # honored via validate_liveness_mode's raise on the explicit
+        # conflict.
+        from .device_liveness import validate_liveness_mode
+
+        self._live = validate_liveness_mode(
+            liveness,
+            symmetry=self._symmetry_enabled,
+            expand_fps=(expand_fps is True),
+            options=options,
+        )
+        if self._live is not None:
+            self._use_fps = False
+        self._live_enabled = self._live == "device" and bool(self._ebit)
+        self._live_paths: Dict[str, Path] = {}
+        self._live_outcomes: Dict[str, dict] = {}
+        self._live_store = None
+        self._elog = None
+        self._elog_count = 0
+        self._live_ins = None
+        if self._live_enabled:
+            from ..storage import LivenessEdgeStore, LivenessInstruments
+
+            # One worst-case wave appends F·A edge rows + F terminal
+            # rows; the default store holds four of them so drains
+            # amortize the eviction pull.
+            self._elog_capacity = _pow2ceil(
+                edge_log_capacity
+                or 4 * (self._F_max * self._A + self._F_max)
+            )
+            if self._elog_capacity < self._F_max * (self._A + 1):
+                raise ValueError(
+                    f"edge_log_capacity={edge_log_capacity} cannot hold "
+                    f"one worst-case wave "
+                    f"({self._F_max * (self._A + 1)} rows)"
+                )
+            self._live_ins = LivenessInstruments(
+                "tpu_bfs", registry=self._registry
+            )
+            self._live_store = LivenessEdgeStore(
+                instruments=self._live_ins, spill_dir=spill_dir,
+                host_budget_mib=host_budget_mib,
+            )
         # State-space cartography (opt-in, telemetry/coverage.py): the
         # per-action/per-property/shape reductions ride INSIDE the wave
         # jit (one extra int32 vector per existing host exit; the deep
@@ -843,7 +901,16 @@ class TpuBfsChecker(Checker):
         # exported pool / padded arrays must survive the call (checkpoints
         # happen mid-run; _jit_take slices the same padded array
         # repeatedly).
-        self._jit_wave = jax.jit(self._wave, donate_argnums=(0,))
+        if self._live_enabled:
+            # The edge log rides the wave as a second donated operand
+            # (it is rebound to the returned one every dispatch, like
+            # the table).
+            def _wave_live(table, elog, *rest):
+                return self._wave(*((table,) + rest), elog=elog)
+
+            self._jit_wave = jax.jit(_wave_live, donate_argnums=(0, 1))
+        else:
+            self._jit_wave = jax.jit(self._wave, donate_argnums=(0,))
         # (bucket width, table capacity) -> AOT-compiled wave: the ladder
         # rungs and table growths each compile once, steady state replays.
         self._wave_exec = {}
@@ -935,7 +1002,7 @@ class TpuBfsChecker(Checker):
         table, fresh, _found, pending = self._insert_sorted(
             table, shi, slo, wave_unique
         )
-        return {
+        out = {
             "table": table,
             "states": states,
             "valid": valid,
@@ -947,8 +1014,18 @@ class TpuBfsChecker(Checker):
             "n_valid": valid.sum(),
             "overflow": pending.sum(),
         }
+        if self._live_enabled:
+            # Analysis roots: condition-false init states, per
+            # eventually property (device_liveness.py).
+            from .device_liveness import seed_root_mask
 
-    def _wave(self, table, states, hi, lo, ebits, depth, mask, depth_cap):
+            out["root_mask"] = seed_root_mask(
+                self._conditions, self._ebit, states, valid
+            )
+        return out
+
+    def _wave(self, table, states, hi, lo, ebits, depth, mask, depth_cap,
+              elog=None):
         model = self._model
         A = self._A
         F = hi.shape[0]
@@ -1002,6 +1079,24 @@ class TpuBfsChecker(Checker):
                 khi, klo = self._key_fn(cand_flat)
             else:
                 khi, klo = chi, clo
+        if elog is not None:
+            # Device-native liveness: this wave's condition-false edge
+            # and terminal rows, appended to the device store in-jit
+            # (one scatter; natural lane order — chi/clo are the
+            # pre-sort candidate fps). None of the wave's own outputs
+            # depend on the log, so results are bit-identical with
+            # liveness off.
+            from .device_liveness import wave_edge_rows
+
+            live_rows, live_n = wave_edge_rows(
+                self._conditions, self._ebit, cond_vals, cand_flat,
+                cvalid_flat, terminal, hi, lo, chi, clo, A,
+            )
+            from ..ops.edge_store import edge_log_append
+
+            elog = edge_log_append(
+                elog, live_rows, live_n, self._elog_capacity
+            )
         if self._wave_dedup == "scatter":
             # Sort-free dedup: the duplicate-tolerant insert resolves
             # in-wave twins itself (owner-ticket tie-break), so the
@@ -1148,6 +1243,11 @@ class TpuBfsChecker(Checker):
         ]
         if self._properties:
             stats.append(out["prop_hit"].any().astype(jnp.int32))
+        if elog is not None:
+            out["elog"] = elog
+            # Absolute fill count — the host's pre-dispatch eviction
+            # decision reads it from the stats pull it already pays.
+            stats.append(elog["count"])
         out["stats"] = jnp.stack(
             [s.astype(jnp.int32) for s in stats]
         )
@@ -1233,7 +1333,8 @@ class TpuBfsChecker(Checker):
         )
         return pool, jnp.int32(0), count
 
-    def _deep_drain(self, width, table, pool, head, count, undiscovered, budget, depth_cap):
+    def _deep_drain(self, width, table, pool, head, count, undiscovered,
+                    budget, depth_cap, elog=None):
         """Runs the BFS inside one device ``while_loop``: each iteration
         pushes the previous wave's fresh states into the FIFO ring, dequeues
         the next ``width`` lanes, and expands them. The loop exits to the
@@ -1265,7 +1366,7 @@ class TpuBfsChecker(Checker):
         PC = self._pool_capacity
         P = len(self._properties)
 
-        def wave_of(tbl, fr):
+        def wave_of(tbl, fr, el=None):
             return self._wave(
                 tbl,
                 fr["states"],
@@ -1275,10 +1376,11 @@ class TpuBfsChecker(Checker):
                 fr["depth"],
                 fr["mask"],
                 depth_cap,
+                elog=el,
             )
 
         frontier0, head, count = self._pool_take(pool, head, count, F)
-        out0 = wave_of(table, frontier0)
+        out0 = wave_of(table, frontier0, elog)
         zl = jnp.zeros((L,), jnp.uint32)
         log0 = {
             "child_hi": zl,
@@ -1325,6 +1427,11 @@ class TpuBfsChecker(Checker):
                 ok &= ~(o["prop_hit"] & undiscovered).any()
             ok &= c["log_n"] + n_new <= L
             ok &= c["count"] + n_new <= PC
+            if elog is not None:
+                # The edge store must absorb another worst-case wave
+                # (B edge rows + F terminal rows) or the host must
+                # evict first.
+                ok &= o["elog"]["count"] + (B + F) <= self._elog_capacity
             if F < self._F_max:
                 # Promote-exit: a backlog beyond one more wave means the
                 # frontier outgrew this rung — hand back to the host,
@@ -1400,7 +1507,10 @@ class TpuBfsChecker(Checker):
                 "head": head,
                 "count": count,
                 "frontier": frontier,
-                "out": wave_of(o["table"], frontier),
+                "out": wave_of(
+                    o["table"], frontier,
+                    o["elog"] if elog is not None else None,
+                ),
                 "log": log,
                 "log_n": c["log_n"] + n_new,
                 "generated": c["generated"] + o["generated"],
@@ -1490,6 +1600,8 @@ class TpuBfsChecker(Checker):
             self._drain_log_capacity,
             self._max_drain_waves,
             self._max_capacity,
+            self._live_enabled,
+            self._elog_capacity if self._live_enabled else None,
         )
 
     # -- host exploration loop ---------------------------------------------
@@ -1591,6 +1703,56 @@ class TpuBfsChecker(Checker):
         with self._phase_overlapped("evict"):
             self._tier.evict(keys)
 
+    # -- device-native liveness (liveness="device") -------------------------
+
+    def _maybe_evict_elog(self, defer=False) -> None:
+        """Evicts the device edge store to the host tier when one more
+        worst-case wave (F·A edge rows + F terminal rows) could
+        overflow it."""
+        self._live_ins.occupancy.set(
+            self._elog_count / self._elog_capacity
+        )
+        if (
+            self._elog_count + self._F_max * (self._A + 1)
+            > self._elog_capacity
+        ):
+            self._evict_elog(defer=defer)
+
+    def _evict_elog(self, defer=False) -> None:
+        """Drains the filled prefix of the device edge store into the
+        host :class:`~..storage.LivenessEdgeStore` and resets the fill
+        count. The device pull stays on the checker thread; with
+        ``defer=True`` (async mode) the host absorb — dedup, budget
+        spill — rides the FIFO pipeline worker, shadowed under the next
+        dispatch."""
+        n = self._elog_count
+        if self._elog is None or n == 0:
+            return
+        if n > self._elog_capacity:
+            raise RuntimeError(
+                "liveness edge store overflowed despite headroom checks "
+                f"({n} > {self._elog_capacity}); this is a bug"
+            )
+        from ..ops.edge_store import EDGE_COLS
+
+        with self._tracer.span("tpu_bfs.liveness.evict", rows=n):
+            cols = {c: np.asarray(self._elog[c])[:n] for c in EDGE_COLS}
+            if defer and self._pipe is not None:
+                self._pipe.submit(
+                    lambda: self._live_store.absorb(**cols)
+                )
+            else:
+                self._live_store.absorb(**cols)
+            self._elog = dict(self._elog, count=jnp.int32(0))
+            self._elog_count = 0
+        self._live_ins.occupancy.set(0.0)
+
+    def _flush_live_edges(self) -> None:
+        """Analysis/checkpoint pre-hook (base's liveness runner): the
+        single-device checker keeps the edge store device-resident, so
+        it must drain before any host read."""
+        self._evict_elog()
+
     def _set_warmup(self, seconds: float) -> None:
         """First-result warmup stamp, mirrored into telemetry so traces
         carry the warmup/steady split the benches subtract."""
@@ -1603,6 +1765,10 @@ class TpuBfsChecker(Checker):
         # Wall-clock burned before the first wave returns — dominated by XLA
         # compilation; benchmarks subtract it to report steady-state rate.
         self.warmup_seconds: Optional[float] = None
+        if self._live_enabled:
+            from ..ops.edge_store import edge_log_new
+
+            self._elog = edge_log_new(self._elog_capacity)
         if self._resume_from is not None:
             table, queue = self._restore(self._resume_from)
         else:
@@ -1630,6 +1796,10 @@ class TpuBfsChecker(Checker):
                 self._explore_waves(table, queue, depth_cap, t_start)
         else:
             self._explore_waves(table, queue, depth_cap, t_start)
+        # Sound `eventually` verdicts (liveness="device"): decide
+        # cycle/masked-terminal existence over the logged
+        # condition-false edge relation, with a concrete certificate.
+        self._run_liveness_analysis("tpu_bfs")
 
     def _compact_chunk(self, chunk, width):
         """Gathers a chunk's live lanes into a dense prefix and narrows it
@@ -1696,6 +1866,11 @@ class TpuBfsChecker(Checker):
         # mutates — the retry-from-checkpoint path never sees a
         # half-applied wave.
         fault_point("device.wave")
+        if self._live_enabled:
+            # Edge-store headroom for this wave's worst case (B edge
+            # rows + F terminal rows) — evict to the host tier first
+            # when the device store could overflow.
+            self._maybe_evict_elog(defer=self._pipe is not None)
         f_in = chunk["hi"].shape[0]
         if (
             len(self._buckets) > 1
@@ -1724,6 +1899,8 @@ class TpuBfsChecker(Checker):
             chunk["mask"],
             jnp.asarray(depth_cap, jnp.int32),
         )
+        if self._live_enabled:
+            args = (table, self._elog) + args[1:]
         key = (table.shape[0], chunk["hi"].shape[0])
         exe = self._wave_exec.get(key)
         if exe is None:
@@ -1740,12 +1917,17 @@ class TpuBfsChecker(Checker):
                 self.warmup_seconds += time.perf_counter() - t0
                 self._wi.warmup.set(self.warmup_seconds)
         if self._attr is None:
-            return exe(*args), chunk
-        # Attribution mode: fence the wave output so the "device" phase
-        # measures dispatch + device compute, not async launch latency.
-        with self._attr.phase("device"):
             out = exe(*args)
-            self._attr.fence(out)
+        else:
+            # Attribution mode: fence the wave output so the "device"
+            # phase measures dispatch + device compute, not async
+            # launch latency.
+            with self._attr.phase("device"):
+                out = exe(*args)
+                self._attr.fence(out)
+        if self._live_enabled:
+            # Rebind the donated edge log to the wave's output.
+            self._elog = out["elog"]
         return out, chunk
 
     def _audit_table(self, table):
@@ -1794,9 +1976,11 @@ class TpuBfsChecker(Checker):
                 wave, chunk = self._call_wave(table, chunk, depth_cap)
             table = wave["table"]
             # Single host transfer per wave: [generated, n_new, overflow,
-            # max_depth, any_prop_hit?]; per-property fingerprints are
-            # pulled only on a hit.
+            # max_depth, any_prop_hit?, edge_count?]; per-property
+            # fingerprints are pulled only on a hit.
             stats = np.asarray(wave["stats"])
+            if self._live_enabled:
+                self._elog_count = int(stats[-1])
             if self._cov is not None:
                 # One extra (small) pull per wave in coverage mode; a
                 # table-growth retry re-expands the same frontier, so
@@ -1925,6 +2109,8 @@ class TpuBfsChecker(Checker):
             wave, chunk = self._call_wave(table, chunk, depth_cap)
             table = wave["table"]
             stats = np.asarray(wave["stats"])
+            if self._live_enabled:
+                self._elog_count = int(stats[-1])
             if self._cov is not None:
                 self._cov.consume_device(
                     np.asarray(wave["cov"]),
@@ -2359,6 +2545,11 @@ class TpuBfsChecker(Checker):
                     budget,
                     depth_cap,
                 )
+                if self._live_enabled:
+                    # Edge-store headroom for at least one wave; the
+                    # drain self-exits when the log fills mid-drain.
+                    self._maybe_evict_elog()
+                    args += (self._elog,)
                 # Compile ahead of the real call so warmup measures pure
                 # compilation: a single deep drain can run the whole
                 # exploration, so "time until the first result returned"
@@ -2429,6 +2620,10 @@ class TpuBfsChecker(Checker):
                         compaction_ratio=compaction,
                     )
                 pool, head, count = res["pool"], res["head"], res["count"]
+                if self._live_enabled:
+                    # Rebind the donated edge log to the drain's output
+                    # (the final unconsumed wave's appends included).
+                    self._elog = res["out"]["elog"]
                 pool_count = int(dstats[5])
                 if self._cov is not None:
                     # The drain's consumed-wave coverage aggregate (the
@@ -2499,7 +2694,8 @@ class TpuBfsChecker(Checker):
                 def fn(*a, _w=width):
                     return self._deep_drain(_w, *a)
 
-                jit_fn = jax.jit(fn, donate_argnums=(0, 1))
+                donate = (0, 1, 7) if self._live_enabled else (0, 1)
+                jit_fn = jax.jit(fn, donate_argnums=donate)
                 self._drain_jits[width] = jit_fn
             t0 = time.perf_counter()
             # AOT-cache miss: the drain rung is about to compile — the
@@ -2554,6 +2750,10 @@ class TpuBfsChecker(Checker):
         self._wave_log.append((child64, np.zeros_like(child64)))
         if self._symmetry_enabled:
             self._key_log.append(fp64_pairs(out["khi"], out["klo"])[valid])
+        if self._live_enabled:
+            self._live_store.add_roots(
+                child64, np.asarray(out["root_mask"])[valid]
+            )
 
         F0 = hi.shape[0]
         init_arrs = {
@@ -2622,6 +2822,14 @@ class TpuBfsChecker(Checker):
             # rebuilt on restore as "known keys not in any run", which
             # always fits the budget.
             payload["storage"] = self._tier.export_state()
+        if self._live_enabled:
+            # v3 payload extension: the condition-false edge relation
+            # (device store flushed first) + roots/terminals, so a
+            # resumed run's final liveness verdict never depends on
+            # where the run was cut.
+            self._evict_elog()
+            payload["liveness"] = self._live_store.export_state()
+            payload["version"] = 3
         return payload
 
     def _restore(self, path):
@@ -2677,6 +2885,26 @@ class TpuBfsChecker(Checker):
                     tracer=self._tracer,
                 )
             self._tier.load_state(storage_state)
+        # Device-liveness state must round-trip with the run: resuming a
+        # liveness="device" run without the knob (or vice versa) would
+        # finish with a silently truncated edge relation — an unsound
+        # verdict — so mode mismatches are refused, not papered over.
+        live_state = payload.get("liveness")
+        if self._live_enabled and live_state is None:
+            raise ValueError(
+                "liveness='device' cannot resume a checkpoint written "
+                "without it: the edges explored before the checkpoint "
+                "were never logged, so the final verdict would be "
+                "unsound"
+            )
+        if live_state is not None:
+            if not self._live_enabled:
+                raise ValueError(
+                    "checkpoint carries a liveness edge store; resume "
+                    "with liveness='device' (dropping it would discard "
+                    "the soundness the original run paid for)"
+                )
+            self._live_store.load_state(live_state)
         insert_keys = keys
         if self._tier is not None and not self._tier.is_empty():
             insert_keys = keys[~self._tier.probe(keys)]
@@ -2870,13 +3098,18 @@ class TpuBfsChecker(Checker):
     def max_depth(self) -> int:
         return self._max_depth
 
+    supports_device_liveness = True
+
     def discoveries(self) -> Dict[str, Path]:
         out = {
             name: self._reconstruct(fp)
             for name, fp in list(self._discoveries_fp.items())
         }
+        out = self._with_device_liveness(out)
         return self._with_lassos(
-            out, self._done_event.is_set(), self._discoveries_fp
+            out,
+            self._done_event.is_set(),
+            set(self._discoveries_fp) | set(self._live_paths),
         )
 
     def handles(self) -> List[threading.Thread]:
@@ -2892,7 +3125,7 @@ class TpuBfsChecker(Checker):
     def _discovery_names(self) -> List[str]:
         # Names only — the flight recorder's digest must not trigger the
         # full path reconstruction discoveries() performs.
-        return list(self._discoveries_fp)
+        return list(set(self._discoveries_fp) | set(self._live_paths))
 
     def state_digest(self) -> dict:
         digest = super().state_digest()
@@ -2903,7 +3136,13 @@ class TpuBfsChecker(Checker):
             checkpoint_path=self._checkpoint_path,
             last_dispatch=self._last_dispatch,
             preempted=self.preempted,
+            liveness_mode=self.liveness_mode,
         )
+        if self._live_store is not None:
+            try:
+                digest["liveness_edge_store"] = self._live_store.stats()
+            except Exception:  # noqa: BLE001 - mid-crash best effort
+                digest["liveness_edge_store"] = None
         if self._tier is not None:
             try:
                 digest["storage"] = self._tier.instruments.bench_stats()

@@ -1,0 +1,259 @@
+"""Device-resident condition-false edge store + lasso-decision kernels.
+
+The device checkers' parent-pointer log records TREE edges only, so it can
+never answer "does the condition-false subgraph contain a cycle?" — the
+question ``eventually`` soundness hangs on (``checker/liveness.py``). This
+module is the missing edge relation and the decision procedure, both
+device-native:
+
+- **Edge log** (``edge_log_new`` / ``edge_log_append``): an append-only
+  ring of (parent_fp, child_fp) u32-pair rows plus two u32 masks —
+  ``emask`` (bit *b* set: both endpoints fail eventually-property *b*'s
+  condition) and ``tmask`` (bit *b* set: the PARENT row is a terminal
+  state failing property *b*; terminal rows carry a (0, 0) child
+  sentinel, which no fingerprint can collide with). The append runs
+  INSIDE the wave jit — one scatter per wave, no host exit — and the
+  store is capacity-budgeted: the host evicts it to
+  ``storage.LivenessEdgeStore`` (the PR 5 host-tier idiom) when a wave
+  could overflow it.
+
+- **Trim kernel** (``lasso_trim``): decides "a cycle exists among these
+  edges" by iterated elimination of states with no outgoing edge — the
+  GPUexplore-style whole-graph fixpoint ("On the Scalability of the
+  GPUexplore Explicit-State Model Checker"). A non-empty fixed point ⟺ a
+  cycle exists: every surviving node keeps an out-edge to a survivor, so
+  survivors carry infinite paths, and a finite graph with one has a
+  cycle. The naive peel is O(longest tail) rounds — fatal on chain-shaped
+  regions (a 100K chain would peel one node per round) — so each round
+  also CONTRACTS out-degree-1 chains with pointer doubling: ``f[v]`` =
+  the unique successor (or ``v`` at branch/dead nodes), squared
+  ``log2(N)`` times, lands every chain node on its chain's terminus; a
+  dead terminus kills the whole chain in that one round. Rounds are thus
+  bounded by the *branching* peel depth, and a pure cycle survives
+  immediately (its pointers never reach a fixpoint, its out-degree never
+  drops).
+
+- **Reach kernel** (``reach_any``): frontier propagation from the
+  condition-false roots with an any-candidate early exit — the
+  restriction that keeps the verdict sound (a condition-false cycle
+  hiding behind a condition-TRUE articulation state is NOT a
+  counterexample; see ``checker/device_liveness.py``).
+
+All three are pure jitted functions over padded power-of-two shapes so
+the analysis pass compiles a handful of shapes, not one per model.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "edge_log_new",
+    "edge_log_append",
+    "lasso_trim",
+    "reach_any",
+    "EDGE_COLS",
+]
+
+# Columns of one edge-log row (struct-of-arrays, all uint32).
+EDGE_COLS = ("phi", "plo", "chi", "clo", "emask", "tmask")
+
+
+def edge_log_new(capacity: int) -> dict:
+    """An empty device edge log: ``capacity`` rows of the six u32
+    columns plus the device-resident fill count."""
+    # One allocation per column — the checkers donate the whole dict
+    # into the wave jits, and a shared zeros buffer would be the same
+    # buffer donated six times.
+    log = {c: jnp.zeros((capacity,), jnp.uint32) for c in EDGE_COLS}
+    log["count"] = jnp.int32(0)
+    return log
+
+
+def edge_log_append(log: dict, rows: dict, n, capacity: int) -> dict:
+    """Appends the first ``n`` rows of ``rows`` (prefix-compacted,
+    same-length u32 columns) at the log's fill point. Runs inside the
+    wave jit; rows past ``capacity`` drop (the host/drain guarantees
+    headroom before dispatch — ``count`` still advances, so an
+    overflow is detectable as ``count > capacity``)."""
+    m = rows["phi"].shape[0]
+    lanes = jnp.arange(m, dtype=jnp.int32)
+    dest = jnp.where(lanes < n, log["count"] + lanes, capacity)
+    out = {
+        c: log[c].at[dest].set(rows[c], mode="drop") for c in EDGE_COLS
+    }
+    out["count"] = log["count"] + n
+    return out
+
+
+def _pow2ceil(n: int) -> int:
+    return 1 << max(0, (int(n) - 1).bit_length())
+
+
+def _seg_sums(active, values, starts):
+    """Per-node segment reductions over src-sorted edges WITHOUT a
+    scatter (XLA CPU scatters serialize; the cumsum-difference form is
+    fully vectorized). ``starts`` is the CSR row-pointer array
+    (int32[N+1] indices into the edge axis). Returns
+    ``(count int32[N], wrapped_sum uint32[N])`` — the sum is modulo
+    2^32 (uint32 cumsum wraparound), which recovers the EXACT single
+    ``values`` entry whenever count == 1, the only case the trim
+    consumes it."""
+    csc = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(active.astype(jnp.int32))]
+    )
+    csd = jnp.concatenate(
+        [
+            jnp.zeros((1,), jnp.uint32),
+            jnp.cumsum(
+                jnp.where(active, values.astype(jnp.uint32), 0),
+                dtype=jnp.uint32,
+            ),
+        ]
+    )
+    count = csc[starts[1:]] - csc[starts[:-1]]
+    total = csd[starts[1:]] - csd[starts[:-1]]
+    return count, total
+
+
+@functools.partial(jax.jit, donate_argnums=())
+def _trim_padded(src, dst, evalid, starts, nvalid):
+    """Trim over padded CSR edges: ``src/dst`` int32[E] sorted by src
+    (< N where valid), ``evalid`` bool[E] (padding rows False, so they
+    contribute nothing to the segment cumsums wherever they sit),
+    ``starts`` int32[N+1] row pointers, ``nvalid`` bool[N]. Returns the
+    surviving-node mask and the round count."""
+    N = nvalid.shape[0]
+    iota = jnp.arange(N, dtype=jnp.int32)
+    doublings = max(1, (N + 1).bit_length())
+
+    def cond(c):
+        alive, changed, _rounds = c
+        return changed & alive.any()
+
+    def body(c):
+        alive, _changed, rounds = c
+        ae = evalid & alive[src] & alive[dst]
+        outdeg, usucc = _seg_sums(ae, dst, starts)
+        f = jnp.where(outdeg == 1, usucc.astype(jnp.int32), iota)
+
+        # Pointer doubling with early exit: most rounds' chains are
+        # short (branch-heavy graphs contract in 1-2 squarings), so the
+        # full log2(N) squarings would be pure waste. A pure f-cycle
+        # never reaches a fixpoint and runs all of them — exactly the
+        # case that must keep squaring (its terminus must land INSIDE
+        # the cycle, where out-degree is 1, never 0).
+        def dbl_cond(c):
+            i, _f, changed = c
+            return (i < doublings) & changed
+
+        def dbl_body(c):
+            i, g, _changed = c
+            g2 = g[g]
+            return i + 1, g2, (g2 != g).any()
+
+        _i, f, _c = jax.lax.while_loop(
+            dbl_cond, dbl_body, (jnp.int32(0), f, jnp.bool_(True))
+        )
+        # A node dies iff its out-degree-1 chain terminates at a node
+        # with no outgoing edge (outdeg 0 includes the node itself when
+        # it is already edge-less). Chains into a cycle never reach a
+        # fixpoint and keep out-degree 1 — they survive, correctly.
+        dead = outdeg[f] == 0
+        alive2 = alive & ~dead
+        return alive2, (alive2 != alive).any(), rounds + 1
+
+    alive, _changed, rounds = jax.lax.while_loop(
+        cond, body, (nvalid, jnp.bool_(True), jnp.int32(0))
+    )
+    return alive, rounds
+
+
+def _csr(src, dst, evalid, n_nodes):
+    """Host-side CSR prep shared by the kernels: sort edges by src,
+    pad to power-of-two shapes (padding rows inactive), build the
+    int32[Np+1] row pointers."""
+    import numpy as np
+
+    E = len(src)
+    order = np.argsort(src, kind="stable")
+    src_s = np.asarray(src, np.int32)[order]
+    dst_s = np.asarray(dst, np.int32)[order]
+    ev_s = np.asarray(evalid, bool)[order]
+    Ep = max(8, _pow2ceil(E))
+    Np = max(8, _pow2ceil(n_nodes))
+    src_p = np.zeros((Ep,), np.int32)
+    dst_p = np.zeros((Ep,), np.int32)
+    ev_p = np.zeros((Ep,), bool)
+    src_p[:E], dst_p[:E], ev_p[:E] = src_s, dst_s, ev_s
+    starts = np.zeros((Np + 1,), np.int32)
+    starts[1 : n_nodes + 1] = np.searchsorted(
+        src_s, np.arange(1, n_nodes + 1)
+    )
+    starts[n_nodes + 1 :] = E
+    return src_p, dst_p, ev_p, starts, Np
+
+
+def lasso_trim(src, dst, evalid, nvalid) -> Tuple[jax.Array, jax.Array]:
+    """Iterative condition-false trim (see module docstring). Inputs are
+    numpy/JAX arrays in any edge order; they are CSR-sorted and padded
+    to power-of-two shapes so repeated analyses share compiles. Returns
+    ``(alive bool[N], rounds)`` sliced back to the caller's node
+    count."""
+    import numpy as np
+
+    N = len(nvalid)
+    src_p, dst_p, ev_p, starts, Np = _csr(src, dst, evalid, N)
+    nv_p = np.zeros((Np,), bool)
+    nv_p[:N] = nvalid
+    alive, rounds = _trim_padded(
+        jnp.asarray(src_p), jnp.asarray(dst_p), jnp.asarray(ev_p),
+        jnp.asarray(starts), jnp.asarray(nv_p),
+    )
+    return np.asarray(alive)[:N], int(rounds)
+
+
+@functools.partial(jax.jit, donate_argnums=())
+def _reach_padded(src_r, dst_r, evalid_r, rstarts, roots, cand):
+    """Frontier propagation over DST-sorted CSR edges: a node joins the
+    reach set when any incoming edge's source is reached (segment-count
+    over its incoming segment — scatter-free, like the trim)."""
+    def cond(c):
+        reach, changed, hit = c
+        return changed & ~hit
+
+    def body(c):
+        reach, _changed, _hit = c
+        ae = evalid_r & reach[src_r]
+        indeg, _tot = _seg_sums(ae, dst_r, rstarts)
+        reach2 = reach | (indeg > 0)
+        return reach2, (reach2 != reach).any(), (reach2 & cand).any()
+
+    reach0 = roots
+    return jax.lax.while_loop(
+        cond, body, (reach0, jnp.bool_(True), (reach0 & cand).any())
+    )
+
+
+def reach_any(src, dst, evalid, roots, cand):
+    """Condition-false reachability from ``roots`` with an early exit
+    the moment any ``cand`` node is reached. Returns ``(hit, reach)``
+    (numpy), ``reach`` being the propagation fixpoint actually computed
+    (exact when ``hit`` is False — the absence certificate)."""
+    import numpy as np
+
+    N = len(roots)
+    # Reachability consumes INCOMING segments: build the CSR over dst.
+    dst_p, src_p, ev_p, rstarts, Np = _csr(dst, src, evalid, N)
+    r_p = np.zeros((Np,), bool)
+    c_p = np.zeros((Np,), bool)
+    r_p[:N], c_p[:N] = roots, cand
+    reach, _changed, hit = _reach_padded(
+        jnp.asarray(src_p), jnp.asarray(dst_p), jnp.asarray(ev_p),
+        jnp.asarray(rstarts), jnp.asarray(r_p), jnp.asarray(c_p),
+    )
+    return bool(hit), np.asarray(reach)[:N]

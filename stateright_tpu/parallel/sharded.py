@@ -148,6 +148,7 @@ class ShardedTpuBfsChecker(Checker):
         coverage=False,
         run_id=None,
         async_pipeline=False,
+        liveness=None,
     ):
         model = options.model
         if not isinstance(model, BatchableModel):
@@ -427,6 +428,35 @@ class ShardedTpuBfsChecker(Checker):
         self._symmetry_enabled = options._symmetry is not None
         self._sym_scheme = sym_key_scheme(options._symmetry)
         self._key_fn = _make_key_fn(model, self._fp_fn, options._symmetry)
+        # Device-native liveness (liveness="device"; see checker/tpu.py
+        # and README "Trustworthy liveness"). Sharded twist: the edge
+        # rows ride each wave's sharded output and are absorbed at the
+        # harvest exit the wave already pays (the sharded drain has no
+        # per-wave host exit to evict through, so liveness forces the
+        # wave-at-a-time path — same clamp as out-of-core mode).
+        from ..checker.device_liveness import validate_liveness_mode
+
+        self._live = validate_liveness_mode(
+            liveness,
+            symmetry=self._symmetry_enabled,
+            expand_fps=False,
+            options=options,
+        )
+        self._live_enabled = self._live == "device" and bool(self._ebit)
+        self._live_paths: Dict[str, Path] = {}
+        self._live_outcomes: Dict[str, dict] = {}
+        self._live_store = None
+        if self._live_enabled:
+            from ..storage import LivenessEdgeStore, LivenessInstruments
+
+            self._max_drain_waves = 1
+            self._live_ins = LivenessInstruments(
+                "sharded_bfs", registry=self._registry
+            )
+            self._live_store = LivenessEdgeStore(
+                instruments=self._live_ins, spill_dir=spill_dir,
+                host_budget_mib=host_budget_mib,
+            )
         self._jit_fp_batch = jax.jit(jax.vmap(self._fp_fn))
         self._jit_key_batch = (
             jax.jit(self._key_fn)
@@ -566,6 +596,8 @@ class ShardedTpuBfsChecker(Checker):
                 wrapped[k] = out[k][None]
         if self._cov is not None:
             wrapped["cov"] = out["cov"][None]
+        if self._live_enabled:
+            wrapped["live_n"] = out["live_n"][None]
         return wrapped
 
     def _wave_core(self, table_loc, states, hi, lo, ebits, depth, mask, depth_cap):
@@ -645,6 +677,22 @@ class ShardedTpuBfsChecker(Checker):
             # Claimed visited-set keys, for checkpoint table rebuild.
             out["new_khi"] = zu.at[out_slot].set(khi, mode="drop")
             out["new_klo"] = zu.at[out_slot].set(klo, mode="drop")
+
+        if self._live_enabled:
+            # Condition-false edge + terminal rows for this shard's
+            # lanes (checker/device_liveness.py) — compacted per shard,
+            # pulled at the harvest exit the wave already pays. The
+            # parent fps are this device's frontier slice; duplicates
+            # across shards dedup in the host store.
+            from ..checker.device_liveness import wave_edge_rows
+
+            live_rows, live_n = wave_edge_rows(
+                self._conditions, self._ebit, cond_vals, cand_flat,
+                cvalid_flat, terminal, hi, lo, chi, clo, A,
+            )
+            for c, col in live_rows.items():
+                out[f"live_{c}"] = col
+            out["live_n"] = live_n
 
         hits, fhis, flos = [], [], []
         for i, p in enumerate(self._properties):
@@ -1248,6 +1296,9 @@ class ShardedTpuBfsChecker(Checker):
             self._explore_deep(table, depth_cap)
         else:
             self._explore_waves(table, depth_cap)
+        # Sound `eventually` verdicts (liveness="device"): the shared
+        # trim/reach pass over the harvested edge relation.
+        self._run_liveness_analysis("sharded_bfs")
 
     def _explore_waves(self, table, depth_cap):
         """Wave-at-a-time host loop. With ``async_pipeline=True`` the
@@ -1437,6 +1488,7 @@ class ShardedTpuBfsChecker(Checker):
                         max_depth=self._max_depth,
                     )
                 wave_new += self._harvest(wave)
+                self._harvest_liveness(wave)
                 if not int(self._pull(wave["overflow"]).sum()):
                     break
                 if self._max_cap_loc is not None and attempt >= 8:
@@ -1545,6 +1597,9 @@ class ShardedTpuBfsChecker(Checker):
         emits the ``sharded_bfs.wave`` span + telemetry the monitor's
         estimator consumes."""
         def verdict():
+            # Edge rows absorb even on zero-fresh waves (cycle-closing
+            # edges target already-visited states).
+            self._harvest_liveness(wave)
             if not total:
                 return
             # _tier_active() inside _harvest_rows is exact HERE: every
@@ -2042,6 +2097,19 @@ class ShardedTpuBfsChecker(Checker):
         self._wave_log.append((child64[fresh], np.zeros((fresh.sum(),), np.uint64)))
         if self._symmetry_enabled:
             self._key_log.append(fp64_pairs(khi, klo)[valid])
+        if self._live_enabled:
+            # Analysis roots: condition-false VALID init states (the
+            # only legal counterexample starting points).
+            from ..checker.device_liveness import seed_root_mask
+
+            root_mask = np.asarray(
+                jax.jit(
+                    lambda s, v: seed_root_mask(
+                        self._conditions, self._ebit, s, v
+                    )
+                )(init_np, jnp.asarray(valid))
+            )
+            self._live_store.add_roots(child64[valid], root_mask[valid])
 
         self._pool_append(
             {
@@ -2111,6 +2179,11 @@ class ShardedTpuBfsChecker(Checker):
             # checkpoint (CRC-validated on restore); the shard tables
             # rebuild as "known keys not in any run".
             payload["storage"] = [t.export_state() for t in self._tiers]
+        if self._live_enabled:
+            # v3 payload extension (see checker/tpu.py): the liveness
+            # edge relation + roots/terminals round-trip with the run.
+            payload["liveness"] = self._live_store.export_state()
+            payload["version"] = 3
         return payload
 
     def _restore(self, path):
@@ -2146,6 +2219,24 @@ class ShardedTpuBfsChecker(Checker):
             self._key_log.append(keys)
         for batch in payload["pool"]:
             self._pool_append(batch)
+
+        # Liveness edge store must round-trip with the run (see
+        # checker/tpu.py for why mode mismatches are refused).
+        live_state = payload.get("liveness")
+        if self._live_enabled and live_state is None:
+            raise ValueError(
+                "liveness='device' cannot resume a checkpoint written "
+                "without it: pre-checkpoint edges were never logged, so "
+                "the final verdict would be unsound"
+            )
+        if live_state is not None:
+            if not self._live_enabled:
+                raise ValueError(
+                    "checkpoint carries a liveness edge store; resume "
+                    "with liveness='device' (dropping it would discard "
+                    "the soundness the original run paid for)"
+                )
+            self._live_store.load_state(live_state)
 
         # Out-of-core checkpoints carry per-shard run lists. Same mesh
         # width: load each store as written. Different width (elastic
@@ -2234,6 +2325,29 @@ class ShardedTpuBfsChecker(Checker):
                     break
                 table = self._grow_table(table, self._cap_loc * 2)
         return table
+
+    def _harvest_liveness(self, wave) -> None:
+        """Absorbs one wave attempt's per-shard condition-false edge
+        rows into the host store (sync harvest or async verdict worker
+        — FIFO keeps absorb order deterministic). Runs even on
+        zero-fresh waves: cycle-closing edges point at already-visited
+        states, which is exactly the n_new == 0 case."""
+        if not self._live_enabled:
+            return
+        from ..ops.edge_store import EDGE_COLS
+
+        ln = np.asarray(self._pull(wave["live_n"]))
+        if not int(ln.sum()):
+            return
+        cols = {
+            c: np.asarray(self._pull(wave[f"live_{c}"]))
+            for c in EDGE_COLS
+        }
+        W = cols["phi"].shape[0] // self._n
+        sel = np.zeros((self._n * W,), bool)
+        for d in range(self._n):
+            sel[d * W : d * W + int(ln[d])] = True
+        self._live_store.absorb(**{c: cols[c][sel] for c in EDGE_COLS})
 
     def _harvest(self, wave):
         """Pulls each device's compacted fresh rows into the host pool;
@@ -2409,13 +2523,18 @@ class ShardedTpuBfsChecker(Checker):
     def max_depth(self) -> int:
         return self._max_depth
 
+    supports_device_liveness = True
+
     def discoveries(self) -> Dict[str, Path]:
         out = {
             name: self._reconstruct(fp)
             for name, fp in list(self._discoveries_fp.items())
         }
+        out = self._with_device_liveness(out)
         return self._with_lassos(
-            out, self._done_event.is_set(), self._discoveries_fp
+            out,
+            self._done_event.is_set(),
+            set(self._discoveries_fp) | set(self._live_paths),
         )
 
     def handles(self) -> List[threading.Thread]:
@@ -2431,7 +2550,7 @@ class ShardedTpuBfsChecker(Checker):
     def _discovery_names(self) -> List[str]:
         # Names only — the flight recorder's digest must not trigger the
         # full path reconstruction discoveries() performs.
-        return list(self._discoveries_fp)
+        return list(set(self._discoveries_fp) | set(self._live_paths))
 
     supports_preempt = True
 

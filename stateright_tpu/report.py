@@ -42,6 +42,14 @@ class Reporter:
         without the coverage ledger (upstream-parity: see MIGRATING.md).
         Default no-op keeps existing reporters source-compatible."""
 
+    def report_liveness(self, inconclusive=(), skipped_crashed=False,
+                        ) -> None:
+        """Liveness-pass honesty lines: properties the bounded host
+        post-pass could not certify within its budget, and the
+        crashed-run warning (a missing counterexample must never be
+        mistaken for certified absence). Default no-op keeps existing
+        reporters source-compatible."""
+
     def delay(self) -> float:
         """Seconds between progress reports."""
         return 1.0
@@ -80,6 +88,20 @@ class WriteReporter(Reporter):
             kind = getattr(p.expectation, "value", str(p.expectation))
             self.writer.write(
                 f'Property "{p.name}" not discovered ({kind})\n'
+            )
+
+    def report_liveness(self, inconclusive=(), skipped_crashed=False,
+                        ) -> None:
+        for name in sorted(inconclusive):
+            self.writer.write(
+                f'Liveness "{name}" inconclusive '
+                "(host post-pass budget exhausted; absence NOT "
+                "certified)\n"
+            )
+        if skipped_crashed:
+            self.writer.write(
+                "Liveness pass skipped: run crashed; absence of "
+                "counterexamples NOT certified\n"
             )
 
 
@@ -124,6 +146,14 @@ class TelemetryReporter(Reporter):
     def report_undiscovered(self, properties) -> None:
         if self.inner is not None:
             self.inner.report_undiscovered(properties)
+
+    def report_liveness(self, inconclusive=(), skipped_crashed=False,
+                        ) -> None:
+        if self.inner is not None:
+            self.inner.report_liveness(
+                inconclusive=inconclusive,
+                skipped_crashed=skipped_crashed,
+            )
 
     def delay(self) -> float:
         return self.inner.delay() if self.inner is not None else 1.0

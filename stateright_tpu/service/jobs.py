@@ -177,6 +177,12 @@ class CheckJob:
         self.packable = False
         self.packable_reason: Optional[str] = None
         self.packed = False
+        # Liveness honesty (device-liveness PR): how this job's
+        # `eventually` verdicts are produced ("device" / "host_pass" /
+        # "default"), and — when the service downgraded the request —
+        # the reason (e.g. a backend without device liveness).
+        self.liveness_mode: Optional[str] = None
+        self.liveness_reason: Optional[str] = None
         # Budget-derived device table sizing (None = service default).
         self.derived_table_capacity: Optional[int] = None
         # Pack-membership clock: join time of the current packed slice.
@@ -357,6 +363,8 @@ class CheckJob:
                 "packable": self.packable,
                 "packable_reason": self.packable_reason,
                 "packed": self.packed,
+                "liveness_mode": self.liveness_mode,
+                "liveness_reason": self.liveness_reason,
                 "preempts": self.preempts,
                 "slices": self.slices,
                 "retries": self.retries,

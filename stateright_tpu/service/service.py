@@ -113,6 +113,14 @@ _GLOBAL_JOB_SEQ = itertools.count()
 # from the live checker after the first spawn.
 _PREEMPTIBLE_SPAWNS = frozenset({"spawn_tpu_bfs", "spawn_sharded_tpu_bfs"})
 
+# Spawn methods whose checkers honor liveness="device"
+# (``Checker.supports_device_liveness``) — the admission-time guess for
+# the honest liveness_mode/downgrade-reason surface, corrected from the
+# live checker after the first spawn.
+_DEVICE_LIVENESS_SPAWNS = frozenset(
+    {"spawn_tpu_bfs", "spawn_sharded_tpu_bfs"}
+)
+
 
 class CheckService:
     """A long-lived, in-process checking service.
@@ -409,6 +417,9 @@ class CheckService:
             job.preemptible = self.spawn_method in _PREEMPTIBLE_SPAWNS
             job.packable = packable
             job.packable_reason = packable_reason
+            job.liveness_mode, job.liveness_reason = (
+                self._classify_liveness(options, spawn)
+            )
             job.derived_table_capacity = derived_table_capacity
             # The zoo kwargs, kept for the durable journal's
             # resubmission spec (the factory closure hides them).
@@ -482,7 +493,38 @@ class CheckService:
         "max_drain_waves",
         "aot_cache",
         "async_pipeline",
+        # The packed engine honors device liveness directly (per-tenant
+        # edge partitions; checker/packed_tenancy.py).
+        "liveness",
     })
+
+    def _classify_liveness(self, options, spawn):
+        """The job's ``eventually``-verdict mode and, when the service
+        must downgrade the request (backend without device liveness),
+        the honest reason — the PR 12 ``packable_reason`` pattern, so
+        unsound-by-default semantics are visible in ``status()`` rather
+        than discovered from a missed counterexample."""
+        requested = (spawn or {}).get(
+            "liveness", self.default_spawn.get("liveness")
+        )
+        host_pass = bool((options or {}).get("complete_liveness"))
+        if requested == "device":
+            if self.spawn_method in _DEVICE_LIVENESS_SPAWNS:
+                return "device", None
+            reason = (
+                f"backend {self.spawn_method!r} has no device liveness; "
+                + (
+                    "downgraded to the host post-pass"
+                    if host_pass
+                    else "downgraded to default (reference-parity) "
+                    "semantics — eventually verdicts keep the "
+                    "documented false negatives"
+                )
+            )
+            return ("host_pass" if host_pass else "default"), reason
+        if host_pass:
+            return "host_pass", None
+        return "default", None
 
     def _classify_packable(self, *, aot_namespace, options, spawn,
                            hbm_budget_mib):
@@ -917,8 +959,21 @@ class CheckService:
             builder = builder.target_max_depth(opts["target_max_depth"])
         if opts.get("symmetry"):
             builder = builder.symmetry()
+        if opts.get("complete_liveness"):
+            builder = builder.complete_liveness(
+                budget_states=opts.get("liveness_budget_states"),
+                deadline_s=opts.get("liveness_deadline_s"),
+            )
         spawn = dict(self.default_spawn)
         spawn.update(job.spawn)
+        if (
+            spawn.get("liveness") == "device"
+            and self.spawn_method not in _DEVICE_LIVENESS_SPAWNS
+        ):
+            # Honest downgrade (job.liveness_reason says so): the
+            # backend cannot honor the knob; passing it through would
+            # fail the job on a TypeError instead.
+            spawn.pop("liveness", None)
         if (
             job.derived_table_capacity is not None
             and "table_capacity" not in job.spawn
@@ -1316,6 +1371,9 @@ class CheckService:
                 self.pack_async
                 or bool(spawn.get("async_pipeline"))
             ),
+            # Pack-safe service-wide knob: per-tenant edge partitions
+            # keep each member's verdict identical to its solo run's.
+            liveness=spawn.get("liveness"),
         )
         members: Dict[str, CheckJob] = {}
         views: Dict[str, object] = {}
@@ -1493,6 +1551,19 @@ class CheckService:
 
     def _finalize(self, job: CheckJob, checker) -> dict:
         """The completed job's verdict record (the bench's per-job row)."""
+        if (
+            getattr(checker, "_complete_liveness", False)
+            and getattr(checker, "_lasso_deadline_s", None) is None
+            and self.stall_deadline_s is not None
+        ):
+            # Stall-watchdog wiring for the host lasso pass: it runs
+            # inside discoveries() AFTER the last wave boundary, so the
+            # auto-preempt hook has nothing left to preempt — instead
+            # the watchdog's deadline bounds the pass itself, which then
+            # yields an honest `inconclusive` (liveness.inconclusive
+            # metric + reporter line) instead of wedging the scheduler
+            # thread for unbounded host minutes.
+            checker._lasso_deadline_s = self.stall_deadline_s
         unique = checker.unique_state_count()
         discoveries = {}
         try:
@@ -1546,6 +1617,13 @@ class CheckService:
         cov = checker.coverage_report()
         if cov is not None:
             result["coverage"] = cov
+        try:
+            # Corrected from the live checker (the admission guess may
+            # predate a downgrade), plus the per-property evidence.
+            job.liveness_mode = checker.liveness_mode
+            result["liveness"] = checker.liveness_report()
+        except Exception:  # noqa: BLE001 - evidence, never the verdict
+            pass
         return result
 
     # -- lifecycle ----------------------------------------------------------

@@ -27,6 +27,7 @@ See README "Memory hierarchy" for the knobs and when eviction pays.
 """
 
 from .bloom import BloomFilter
+from .edge_log import LivenessEdgeStore, LivenessInstruments
 from .runs import RUN_BLOCK, FingerprintRun, decode_varint_u64, encode_varint_u64
 from .tiered import (
     StorageInstruments,
@@ -39,6 +40,8 @@ from .tiered import (
 __all__ = [
     "BloomFilter",
     "FingerprintRun",
+    "LivenessEdgeStore",
+    "LivenessInstruments",
     "RUN_BLOCK",
     "StorageInstruments",
     "TenantPartitions",

@@ -199,7 +199,11 @@ class DeviceCoverage:
         else:
             prop_ex = jnp.zeros((0,), i32)
         succ = cvalid.sum(axis=1, dtype=i32)
-        sbin = zero
+        # Per-lane bin vector, NOT the scalar zero: with a single
+        # successor bin (action_count == 1) the loop below never runs,
+        # and a scalar index into succ_hist cannot take the (F,)-shaped
+        # eval_mask update (latent until the first A=1 coverage run).
+        sbin = jnp.zeros_like(succ)
         for j in range(self.succ_bins - 1):
             sbin = sbin + (succ > (1 << j)).astype(i32)
         succ_hist = jnp.zeros((self.succ_bins,), i32).at[sbin].add(

@@ -50,6 +50,7 @@ __all__ = [
     "FaultInjector",
     "FaultSpec",
     "HostProbeFault",
+    "LivenessEvictFault",
     "PackTenantFault",
     "SpillFault",
     "TenantFaultError",
@@ -111,6 +112,13 @@ class PackTenantFault(FaultError):
     """A per-tenant slice of packed host work (verdict/evict) raised."""
 
     fault_class = "pack_tenant"
+
+
+class LivenessEvictFault(FaultError):
+    """A liveness edge-store eviction absorb died mid-run (device pull,
+    numpy OOM, spill)."""
+
+    fault_class = "liveness_evict"
 
 
 class TenantFaultError(Exception):
@@ -181,6 +189,7 @@ _SITE_EXC = {
     "checkpoint.write": CheckpointWriteFault,
     "pack.tenant.verdict": PackTenantFault,
     "pack.tenant.evict": PackTenantFault,
+    "liveness.edge_evict": LivenessEvictFault,
 }
 
 # Sites that exist in the tree — fail fast on typos in test specs.

@@ -1,0 +1,440 @@
+"""Device-native liveness (``liveness="device"``): sound ``eventually``
+verdicts from the condition-false edge store + trim/reach kernels.
+
+The contract under test (ISSUE 14 acceptance): device-liveness verdicts
+match ``lasso_discoveries`` exactly — both certificate shapes (lasso and
+masked terminal) — on every liveness model shape, on both device
+checkers, composed with packing, async pipelining, out-of-core eviction,
+and preempt/resume.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from stateright_tpu import Property
+from stateright_tpu.checker.liveness import lasso_discoveries
+from stateright_tpu.core.batch import BatchableModel
+from stateright_tpu.core.model import Model
+
+from test_liveness import _Cycler, _Diamond, eventually_odd
+
+
+class PackedDGraph(Model, BatchableModel):
+    """The host fixtures' ``DGraph`` (eventually-odd property) as a
+    packed model, so every graph shape in tests/test_liveness.py runs
+    on the device checkers too. States are u32 node ids; actions index
+    each node's sorted successor list."""
+
+    def __init__(self, *paths):
+        self.inits = set()
+        self.edges = {}
+        for path in paths:
+            src = path[0]
+            self.inits.add(src)
+            for dst in path[1:]:
+                self.edges.setdefault(src, set()).add(dst)
+                src = dst
+        nodes = set(self.inits) | set(self.edges)
+        for ds in self.edges.values():
+            nodes |= ds
+        size = max(nodes) + 1
+        self._A_max = max(
+            (len(v) for v in self.edges.values()), default=1
+        ) or 1
+        self._succ = np.zeros((size, self._A_max), np.uint32)
+        self._vld = np.zeros((size, self._A_max), bool)
+        for s, ds in self.edges.items():
+            for i, d in enumerate(sorted(ds)):
+                self._succ[s, i] = d
+                self._vld[s, i] = True
+
+    # -- host protocol -----------------------------------------------------
+
+    def init_states(self):
+        return sorted(self.inits)
+
+    def actions(self, state, actions):
+        actions.extend(
+            i for i in range(self._A_max) if self._vld[state, i]
+        )
+
+    def next_state(self, state, action):
+        if not self._vld[state, action]:
+            return None
+        return int(self._succ[state, action])
+
+    def properties(self):
+        return [eventually_odd()]
+
+    # -- packed protocol ----------------------------------------------------
+
+    def packed_action_count(self):
+        return self._A_max
+
+    def packed_init_states(self):
+        return {"s": jnp.asarray(sorted(self.inits), jnp.uint32)}
+
+    def packed_step(self, state, action_id):
+        s = state["s"]
+        nxt = jnp.asarray(self._succ)[s, action_id]
+        valid = jnp.asarray(self._vld)[s, action_id]
+        return {"s": jnp.where(valid, nxt, s)}, valid
+
+    def packed_conditions(self):
+        return [lambda st: (st["s"] % 2) == 1]
+
+    def pack_state(self, host_state):
+        return {"s": np.uint32(host_state)}
+
+    def unpack_state(self, packed):
+        return int(packed["s"])
+
+
+def _chain(n, tail_odd=True):
+    """0 -> 2 -> ... -> 2(n-1) [-> odd terminal]: the absence-certification
+    shape (no cycle; the only terminal is condition-true)."""
+    path = [2 * i for i in range(n)]
+    if tail_odd:
+        path.append(2 * n + 1)
+    return PackedDGraph(path)
+
+
+# Every graph shape tests/test_liveness.py exercises, plus the absence
+# chain. (name, model factory, expected-verdict hints.)
+GRAPH_CASES = {
+    "cycle": lambda: PackedDGraph([0, 2, 4, 2]),
+    "dag_join_terminal": lambda: PackedDGraph([0, 1, 4], [0, 2, 4]),
+    "terminal_init": lambda: PackedDGraph([2]),
+    "cycle_through_odd": lambda: PackedDGraph([0, 1, 2, 0]),
+    "terminal_preferred": lambda: PackedDGraph([0, 2]),
+    "absence_chain": lambda: _chain(64),
+}
+
+
+def _spawn(model, kind, *, liveness=None, **kw):
+    b = model.checker()
+    if kind == "tpu":
+        return b.spawn_tpu_bfs(
+            frontier_capacity=16, table_capacity=1 << 9,
+            liveness=liveness, **kw,
+        ).join()
+    assert kind == "sharded"
+    return b.spawn_sharded_tpu_bfs(
+        frontier_per_device=16, table_capacity_per_device=1 << 9,
+        liveness=liveness, **kw,
+    ).join()
+
+
+def _assert_sound_eventually(model, prop, path):
+    """A valid `eventually` counterexample: all states condition-false,
+    ending in a revisit (lasso) or a terminal state (maximal path)."""
+    states = path.into_states()
+    assert not any(prop.condition(model, s) for s in states)
+    last = states[-1]
+    if last in states[:-1]:
+        return  # lasso certificate
+    acts = []
+    model.actions(last, acts)
+    succs = [model.next_state(last, a) for a in acts]
+    assert not any(
+        ns is not None and model.within_boundary(ns) for ns in succs
+    )
+
+
+def _expected_verdicts(model):
+    """Ground truth: the default-semantics discoveries plus the exact
+    host lasso pass on top — what device liveness must match."""
+    plain = _spawn(model, "tpu")
+    have = set(plain.discoveries())
+    extra = lasso_discoveries(model, model.properties(), have)
+    return have | set(extra)
+
+
+@pytest.mark.parametrize("case", sorted(GRAPH_CASES))
+@pytest.mark.parametrize("kind", ["tpu", "sharded"])
+def test_verdicts_match_lasso_discoveries(case, kind):
+    model = GRAPH_CASES[case]()
+    expected = _expected_verdicts(GRAPH_CASES[case]())
+    dev = _spawn(model, kind, liveness="device")
+    assert dev.worker_error() is None
+    found = dev.discoveries()
+    assert set(found) == expected
+    prop = model.properties()[0]
+    for path in found.values():
+        _assert_sound_eventually(model, prop, path)
+    # The absence/counterexample evidence is recorded per property.
+    rep = dev.liveness_report()
+    assert rep["mode"] == "device"
+    if "odd" not in expected:
+        assert rep["outcomes"]["odd"]["verdict"] == "absent"
+
+
+@pytest.mark.parametrize("kind", ["tpu", "sharded"])
+def test_fixture_models_match(kind):
+    for model_cls in (_Cycler, _Diamond):
+        expected = _expected_verdicts(model_cls())
+        dev = _spawn(model_cls(), kind, liveness="device")
+        assert set(dev.discoveries()) == expected
+        prop = dev.model().properties()[0]
+        for path in dev.discoveries().values():
+            _assert_sound_eventually(dev.model(), prop, path)
+
+
+def test_async_pipeline_and_out_of_core_compose():
+    # Async + tiered store + a tiny edge log (forced mid-run evictions):
+    # the verdict and certificate must match the plain device run.
+    model = PackedDGraph([0, 2, 4, 2], [0, 6], [6, 8, 10, 6])
+    base = _spawn(PackedDGraph([0, 2, 4, 2], [0, 6], [6, 8, 10, 6]),
+                  "tpu", liveness="device")
+    composed = _spawn(
+        model, "tpu", liveness="device", async_pipeline=True,
+        edge_log_capacity=64,
+    )
+    assert composed.worker_error() is None
+    assert set(composed.discoveries()) == set(base.discoveries())
+    assert (
+        composed.discoveries()["odd"].into_states()
+        == base.discoveries()["odd"].into_states()
+    )
+    # The tiny log really evicted mid-run (not just the final flush).
+    assert composed._live_store.stats()["evictions"] >= 1
+
+
+def test_preempt_resume_preserves_edge_log():
+    # Preempt mid-exploration; the edge store rides the v3 payload and
+    # the resumed run's verdict is identical to an uninterrupted one.
+    model_fn = lambda: _chain(48)  # noqa: E731
+    baseline = _spawn(model_fn(), "tpu", liveness="device")
+    assert baseline._live_outcomes["odd"]["verdict"] == "absent"
+
+    ck = model_fn().checker().spawn_tpu_bfs(
+        frontier_capacity=8, table_capacity=1 << 9, liveness="device",
+        max_drain_waves=2,
+    )
+    ck.request_preempt()
+    for h in ck.handles():
+        h.join()
+    if not ck.preempted:
+        pytest.skip("run finished before the preempt could land")
+    payload = ck.preempt_payload()
+    assert payload["version"] == 3
+    assert payload["liveness"]["edges_logged"] >= 0
+    resumed = model_fn().checker().spawn_tpu_bfs(
+        frontier_capacity=8, table_capacity=1 << 9, liveness="device",
+        resume_from=payload,
+    ).join()
+    assert resumed.worker_error() is None
+    assert resumed.unique_state_count() == baseline.unique_state_count()
+    assert resumed._live_outcomes["odd"]["verdict"] == "absent"
+    # The pre-preempt incarnation's edges survived into the verdict.
+    assert (
+        resumed._live_store.stats()["edges_logged"]
+        >= baseline._live_store.stats()["edges_logged"]
+    )
+
+
+def test_packed_tenants_match_solo():
+    from stateright_tpu.checker.packed_tenancy import TenantPackedEngine
+
+    solo = _spawn(PackedDGraph([0, 2, 4, 2]), "tpu", liveness="device")
+    eng = TenantPackedEngine(
+        PackedDGraph([0, 2, 4, 2]), frontier_capacity=16,
+        table_capacity=1 << 10, max_tenants=4, liveness="device",
+    )
+    views = {k: eng.admit(k) for k in ("a", "b", "c")}
+    done = set()
+    for _ in range(200):
+        done |= set(eng.step())
+        if done >= set(views):
+            break
+    eng.close()
+    assert done >= set(views)
+    for v in views.values():
+        assert v.liveness_mode == "device"
+        assert (
+            {k: p.into_states() for k, p in v.discoveries().items()}
+            == {
+                k: p.into_states()
+                for k, p in solo.discoveries().items()
+            }
+        )
+
+
+def test_mode_mismatch_and_cap_refusals():
+    model = PackedDGraph([0, 2, 4, 2])
+    with pytest.raises(ValueError, match="uncapped"):
+        model.checker().target_max_depth(3).spawn_tpu_bfs(
+            liveness="device"
+        )
+    with pytest.raises(ValueError, match="expand_fps"):
+        PackedDGraph([0, 2]).checker().spawn_tpu_bfs(
+            liveness="device", expand_fps=True
+        )
+    with pytest.raises(ValueError, match="liveness"):
+        model.checker().spawn_tpu_bfs(liveness="both")
+    # Resume mode mismatches are refused in either direction.
+    ck = model.checker().spawn_tpu_bfs(
+        frontier_capacity=8, table_capacity=1 << 9, liveness="device",
+        max_drain_waves=2,
+    )
+    ck.request_preempt()
+    for h in ck.handles():
+        h.join()
+    if ck.preempted:
+        # The restore runs on the worker thread; join() surfaces its
+        # ValueError as the worker failure.
+        with pytest.raises(RuntimeError) as ei:
+            model.checker().spawn_tpu_bfs(
+                resume_from=ck.preempt_payload()
+            ).join()
+        assert isinstance(ei.value.__cause__, ValueError)
+        assert "liveness" in str(ei.value.__cause__)
+
+
+def test_trim_kernel_shapes():
+    from stateright_tpu.ops.edge_store import lasso_trim, reach_any
+
+    # Chain: dies in O(1) rounds via pointer-doubling contraction, NOT
+    # O(n) peels — the property that keeps absence certification fast.
+    n = 4096
+    src = np.arange(n - 1, dtype=np.int32)
+    dst = np.arange(1, n, dtype=np.int32)
+    ev = np.ones((n - 1,), bool)
+    nv = np.ones((n,), bool)
+    alive, rounds = lasso_trim(src, dst, ev, nv)
+    assert not alive.any()
+    assert rounds <= 3
+
+    # Pure cycle: everything survives in one round.
+    csrc = np.arange(8, dtype=np.int32)
+    cdst = np.roll(csrc, -1).astype(np.int32)
+    alive, _r = lasso_trim(
+        csrc, cdst, np.ones((8,), bool), np.ones((8,), bool)
+    )
+    assert alive.all()
+
+    # Chain INTO a cycle: the whole tail survives (leads to a cycle).
+    src2 = np.array([0, 1, 2, 3], np.int32)
+    dst2 = np.array([1, 2, 3, 2], np.int32)
+    alive, _r = lasso_trim(
+        src2, dst2, np.ones((4,), bool), np.ones((4,), bool)
+    )
+    assert alive.all()
+
+    # Reachability with early exit: roots {0} reach candidate {3}.
+    hit, _reach = reach_any(
+        src2, dst2, np.ones((4,), bool),
+        np.array([True, False, False, False]),
+        np.array([False, False, False, True]),
+    )
+    assert hit
+    # ...but not an unreachable candidate.
+    hit, reach = reach_any(
+        np.array([1], np.int32), np.array([2], np.int32),
+        np.ones((1,), bool),
+        np.array([True, False, False]),
+        np.array([False, False, True]),
+    )
+    assert not hit
+    assert reach.tolist() == [True, False, False]
+
+
+def test_edge_store_checkpoint_roundtrip(tmp_path):
+    from stateright_tpu.storage import LivenessEdgeStore
+
+    store = LivenessEdgeStore()
+    store.absorb(
+        phi=np.array([1, 1, 2], np.uint32),
+        plo=np.array([0, 0, 0], np.uint32),
+        chi=np.array([2, 2, 0], np.uint32),
+        clo=np.array([0, 0, 0], np.uint32),
+        emask=np.array([1, 1, 0], np.uint32),  # duplicate edge dedups
+        tmask=np.array([0, 0, 1], np.uint32),
+    )
+    store.add_roots(np.array([1 << 32], np.uint64), np.array([1]))
+    state = store.export_state()
+    other = LivenessEdgeStore()
+    other.load_state(state)
+    src, dst, roots, terms = other.property_slice(0)
+    assert len(src) == 1  # deduped
+    assert roots.tolist() == [1 << 32]
+    assert terms.tolist() == [2 << 32]
+    # Corrupt the CRC: the restore must refuse.
+    bad = dict(state, crc=state["crc"] ^ 1)
+    with pytest.raises(ValueError, match="CRC"):
+        LivenessEdgeStore().load_state(bad)
+
+
+def test_host_pass_budget_inconclusive(capsys):
+    # Satellite: the bounded host post-pass yields an honest
+    # `inconclusive` (reporter line + metric) instead of an unbounded
+    # stall inside discoveries().
+    import io
+
+    from stateright_tpu.checker.liveness import (
+        INCONCLUSIVE,
+        find_eventually_lasso,
+    )
+    from stateright_tpu.report import WriteReporter
+    from test_liveness import eventually_odd
+    from fixtures import DGraph
+
+    g = DGraph.with_property(eventually_odd())
+    g.inits.add(0)
+    for i in range(500):
+        g.edges[2 * i] = {2 * (i + 1)}
+    g.edges[2 * 500] = {2 * 500 + 1}
+    assert (
+        find_eventually_lasso(g, g.prop, budget_states=10)
+        is INCONCLUSIVE
+    )
+    # Unbounded: certifies absence on the same region.
+    assert find_eventually_lasso(g, g.prop) is None
+
+    # Chain ends at an ODD terminal: the default semantics find nothing
+    # (no counterexample exists) and certifying absence needs the full
+    # region — which the budget forbids.
+    checker = (
+        DGraph.with_property(eventually_odd())
+        .with_path([2 * i for i in range(200)] + [401])
+        .checker()
+        .complete_liveness(budget_states=5)
+        .spawn_bfs()
+        .join()
+    )
+    assert checker.discoveries() == {}
+    assert checker._lasso_inconclusive == ["odd"]
+    assert checker.liveness_report()["inconclusive"] == ["odd"]
+    assert (
+        checker.metrics().snapshot().get("liveness.inconclusive") == 1
+    )
+    out = io.StringIO()
+    checker.report(WriteReporter(out))
+    assert 'Liveness "odd" inconclusive' in out.getvalue()
+
+
+def test_crashed_run_skip_is_signaled():
+    # Satellite: a crashed run's skipped pass must never read as
+    # absence — counter + WriteReporter warning.
+    import io
+
+    from stateright_tpu.report import WriteReporter
+    from stateright_tpu.utils.faults import FaultSpec, inject
+
+    with inject(FaultSpec("device.wave", at=0)):
+        ck = _Cycler().checker().complete_liveness().spawn_tpu_bfs(
+            frontier_capacity=16, table_capacity=1 << 9
+        )
+        for h in ck.handles():
+            h.join()
+    assert ck.worker_error() is not None
+    assert ck.discoveries() == {}
+    assert (
+        ck.metrics().snapshot().get("liveness.skipped_crashed_run") == 1
+    )
+    out = io.StringIO()
+    with pytest.raises(RuntimeError):
+        ck.report(WriteReporter(out))
+    assert "Liveness pass skipped" in out.getvalue()

@@ -740,3 +740,109 @@ def test_close_reports_stuck_scheduler():
     after = metrics_registry().snapshot().get("service.close.stuck", 0)
     assert after == before + 1
     release.set()
+
+
+# -- device-liveness edge store under adversity (ISSUE 14) -------------------
+
+
+def _live_graph():
+    """A lasso-bearing graph with enough edges to force mid-run edge
+    evictions under a tiny device store."""
+    from test_device_liveness import PackedDGraph
+
+    return PackedDGraph(
+        [2 * i for i in range(24)] + [2],  # long even chain closing a cycle
+        [0, 46],
+    )
+
+
+_LIVE_SPAWN = {
+    "frontier_capacity": 16,
+    "table_capacity": 1 << 10,
+    "liveness": "device",
+    # Minimum legal capacity (F·(A+1) rows): every couple of waves
+    # evicts, so the injected fault lands MID-eviction, mid-run.
+    "edge_log_capacity": 64,
+    "aot_cache": "t-flt-live",
+}
+
+
+@pytest.fixture(scope="module")
+def live_baseline():
+    svc = _service()
+    try:
+        r = svc.submit(_live_graph, spawn=dict(_LIVE_SPAWN)).result(
+            timeout=300
+        )
+    finally:
+        svc.close()
+    assert r["liveness"]["mode"] == "device"
+    assert "odd" in r["discoveries"]
+    return r
+
+
+def test_liveness_edge_evict_fault_retries_bit_identical(live_baseline):
+    """A fault mid-edge-eviction (the liveness.edge_evict seam inside
+    LivenessEdgeStore.absorb) faults the slice; the checkpointed retry
+    recovers and the device-liveness verdict is bit-identical to the
+    fault-free run — a dropped edge store must never decay into a
+    silent 'absence'."""
+    svc = _service()
+    try:
+        with inject(FaultSpec("liveness.edge_evict", at=1)) as inj:
+            h = svc.submit(_live_graph, spawn=dict(_LIVE_SPAWN))
+            r = h.result(timeout=300)
+        assert inj.triggered() == 1
+        st = h.status()
+        assert st["retries"] == 1
+        assert st["faults"][0]["class"] == "liveness_evict"
+        assert st["liveness_mode"] == "device"
+        assert r["unique"] == live_baseline["unique"]
+        assert set(r["discoveries"]) == set(live_baseline["discoveries"])
+        assert (
+            r["liveness"]["outcomes"]["odd"]["verdict"] == "counterexample"
+        )
+        assert _golden(r["report"]) == _golden(live_baseline["report"])
+    finally:
+        svc.close()
+
+
+def test_liveness_survives_stall_preempt_resume(live_baseline):
+    """Preempt mid-exploration (stall-watchdog auto-preempt), resume:
+    the edge log rides the v3 payload intact and the resumed run's
+    device verdict matches the uninterrupted one exactly."""
+    svc = _service(packing=False, stall_deadline_s=0.3, quantum_s=30.0)
+    try:
+        with inject(FaultSpec("wave.stall", at=2, stall_s=1.2)) as inj:
+            h = svc.submit(_live_graph, spawn=dict(_LIVE_SPAWN))
+            r = h.result(timeout=300)
+        assert inj.triggered() == 1
+        st = h.status()
+        assert st["stall_preempts"] == 1
+        assert st["preempts"] >= 1
+        assert r["unique"] == live_baseline["unique"]
+        assert set(r["discoveries"]) == set(live_baseline["discoveries"])
+        # The edge relation accumulated across BOTH incarnations (the
+        # resumed store starts from the payload, not from scratch).
+        assert (
+            r["liveness"]["edge_store"]["edges_logged"]
+            >= live_baseline["liveness"]["edge_store"]["edges_logged"]
+        )
+        assert _golden(r["report"]) == _golden(live_baseline["report"])
+    finally:
+        svc.close()
+
+
+def test_liveness_metric_family_is_hygiene_clean():
+    from stateright_tpu.telemetry import metrics_registry
+    from stateright_tpu.telemetry.server import registry_hygiene_problems
+
+    reg = metrics_registry()
+    reg.counter("fault.by_class.liveness_evict")
+    reg.counter("fault.injected.liveness.edge_evict")
+    reg.counter("liveness.inconclusive")
+    reg.counter("liveness.skipped_crashed_run")
+    problems = [
+        p for p in registry_hygiene_problems(reg) if "liveness" in p
+    ]
+    assert problems == []

@@ -367,3 +367,39 @@ def test_terminal_merge_at_dag_join_pinned_on_device_checker():
     path = fixed.discoveries().get("odd")
     assert path is not None
     assert path.into_states() == [0, 2, 4]
+
+
+def test_pinned_false_negatives_fixed_under_device_liveness():
+    # ISSUE 14 acceptance: the two pinned false-negative shapes above
+    # (terminal-merge at the DAG join, the cycle) now yield REAL
+    # counterexamples under liveness="device" — no host post-pass —
+    # while the default-mode pins in this file stay green untouched.
+    fixed = (
+        _Diamond()
+        .checker()
+        .spawn_tpu_bfs(
+            frontier_capacity=8, table_capacity=1 << 9,
+            liveness="device",
+        )
+        .join()
+    )
+    assert fixed.worker_error() is None
+    path = fixed.discoveries().get("odd")
+    assert path is not None
+    assert path.into_states() == [0, 2, 4]  # the masked-terminal shape
+    assert fixed.liveness_mode == "device"
+
+    cyc = (
+        _Cycler()
+        .checker()
+        .spawn_tpu_bfs(
+            frontier_capacity=16, table_capacity=1 << 9,
+            liveness="device",
+        )
+        .join()
+    )
+    path = cyc.discoveries().get("three")
+    assert path is not None
+    states = path.into_states()
+    assert states[-1] in states[:-1]  # the lasso shape
+    assert 3 not in states
