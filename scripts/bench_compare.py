@@ -373,6 +373,99 @@ def ab_async_report(path, out=sys.stdout):
     return 0
 
 
+def swarm_report(path, out=sys.stdout):
+    """The swarm-verification table from one ``bench.py --swarm``
+    record (BENCH_r15): per-leg time-to-first-violation (swarm vs
+    exhaustive where exhaustive exists), walk throughput, and the
+    honest unique-coverage sample. Always advisory (exit 0 when the
+    record parsed): wall-clock claims are noise on shared CPU boxes;
+    the determinism asserts live in the bench child and the tier-1
+    suite."""
+    with open(path) as f:
+        rec = None
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                obj = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(obj, dict) and "swarm" in obj:
+                rec = obj
+    if rec is None:
+        print(
+            f"error: {path}: no swarm record found (produce one with "
+            "bench.py --swarm)",
+            file=sys.stderr,
+        )
+        return 2
+    sw = rec["swarm"]
+    out.write(
+        f"swarm verification ({rec.get('device')}"
+        + (", advisory" if rec.get("advisory") else "")
+        + ")\n\n"
+    )
+    header = (
+        f"{'leg':<24} {'swarm ttfv':>11} {'exhaustive':>11} "
+        f"{'speedup':>8} {'sample uniq':>12}"
+    )
+    out.write(header + "\n" + "-" * len(header) + "\n")
+
+    def sample_cell(leg):
+        s = leg.get("swarm_sample") or leg.get("sample") or {}
+        u = s.get("unique_sample")
+        if u is None:
+            return "-"
+        return ("≥" if s.get("saturated") else "") + f"{u:,}"
+
+    raft = sw.get("raft3_check_live") or {}
+    out.write(
+        f"{'raft-3 check-live':<24} "
+        f"{_fmt(raft.get('swarm_ttfv_s')) + 's':>11} "
+        f"{_fmt(raft.get('exhaustive_ttfv_s')) + 's':>11} "
+        f"{_fmt(raft.get('speedup')) + 'x':>8} "
+        f"{sample_cell(raft):>12}\n"
+    )
+    two = sw.get("two_phase") or sw.get("two_phase_5") or {}
+    two_label = f"{two.get('model', '2pc')} witnesses"
+    out.write(
+        f"{two_label:<24} "
+        f"{_fmt(two.get('swarm_wall_s')) + 's':>11} "
+        f"{_fmt(two.get('exhaustive_wall_s')) + 's':>11} "
+        f"{'':>8} {sample_cell(two):>12}\n"
+    )
+    kv = sw.get("sharded_kv") or {}
+    if kv.get("exhaustive_found"):
+        ex_cell = _fmt(kv.get("exhaustive_ttfv_s")) + "s"
+        sp_cell = _fmt(kv.get("speedup_lower_bound")) + "x"
+    else:
+        budget = kv.get("exhaustive_budget_s")
+        bound = kv.get("speedup_lower_bound")
+        ex_cell = f">{budget:.0f}s" if budget is not None else "-"
+        sp_cell = f">={bound:.0f}x" if bound is not None else "-"
+    out.write(
+        f"{'sharded_kv 4x8 (~1e14)':<24} "
+        f"{_fmt(kv.get('ttfv_s')) + 's':>11} "
+        f"{ex_cell:>11} {sp_cell:>8} {sample_cell(kv):>12}\n"
+    )
+    if not kv.get("exhaustive_found"):
+        out.write(
+            f"  (exhaustive explored "
+            f"{kv.get('exhaustive_states_explored', 0):,} states to "
+            f"depth {kv.get('exhaustive_max_depth')} inside its wall "
+            "budget without reaching the violation)\n"
+        )
+    if kv.get("walk_steps_per_s") is not None:
+        out.write(
+            f"\nwalk throughput: {kv['walk_steps_per_s']:,.0f} "
+            f"walk-steps/s over {kv.get('walk_steps', 0):,} steps "
+            f"(violation: {kv.get('violation')!r} at depth "
+            f"{kv.get('violation_len')})\n"
+        )
+    return 0
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(
         description="Per-leg rate deltas between bench trajectory files, "
@@ -401,6 +494,12 @@ def main(argv=None):
         "realized utilization) from one bench.py --async-ab record",
     )
     parser.add_argument(
+        "--swarm", action="store_true",
+        help="render the swarm-verification table (ttfv vs exhaustive, "
+        "walk throughput, coverage sample) from one bench.py --swarm "
+        "record",
+    )
+    parser.add_argument(
         "--service-trajectory", action="store_true",
         help="render the concurrent-throughput trajectory across "
         "service bench records (time-sliced r10 vs tenant-packed r12+: "
@@ -411,6 +510,19 @@ def main(argv=None):
 
     if args.service_trajectory:
         return service_trajectory(args.files)
+
+    if args.swarm:
+        if len(args.files) != 1:
+            print(
+                "error: --swarm takes exactly one bench record",
+                file=sys.stderr,
+            )
+            return 2
+        try:
+            return swarm_report(args.files[0])
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"error: {args.files[0]}: {e}", file=sys.stderr)
+            return 2
 
     if args.ab_async:
         if len(args.files) != 1:

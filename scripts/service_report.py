@@ -94,6 +94,19 @@ def summarize(rec):
         "liveness_downgraded": sum(
             1 for j in per_job if j.get("liveness_reason")
         ),
+        # Verification modes (swarm PR): exhaustive BFS vs randomized
+        # walk jobs sharing the one device.
+        "modes": {
+            mode: sum(
+                1
+                for j in per_job
+                if j.get("mode", "exhaustive") == mode
+            )
+            for mode in ("exhaustive", "swarm")
+            if any(
+                j.get("mode", "exhaustive") == mode for j in per_job
+            )
+        },
         "per_job": per_job,
     }
 
@@ -152,6 +165,13 @@ def render(summary, out=sys.stdout):
         f"{summary['retries_total'] or 0} retries, "
         f"{summary['jobs_quarantined']} quarantined\n"
     )
+    vmodes = summary.get("modes") or {}
+    if len(vmodes) > 1 or "swarm" in vmodes:
+        w(
+            "  modes: "
+            + ", ".join(f"{n} {m}" for m, n in sorted(vmodes.items()))
+            + "\n"
+        )
     modes = summary.get("liveness_modes") or {}
     if modes:
         rendered = ", ".join(f"{n} {m}" for m, n in modes.items())

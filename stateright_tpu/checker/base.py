@@ -92,6 +92,24 @@ class Checker(Generic[State, Action]):
     # NotImplementedError at slice time.
     supports_preempt = False
 
+    # Honest packability surface (same convention): True on backends
+    # whose runs can share one physical dispatch with other tenants
+    # (tenant-packed BFS waves, swarm lane blocks); ``packing_reason``
+    # is the human-readable downgrade reason on the backends that
+    # cannot. This is the backend's STATIC self-declaration; the
+    # per-job ``packable``/``packable_reason`` fields in job status() /
+    # HTTP / service_report come from the service's admission
+    # classifiers, which also account for service-level knobs (packing
+    # disabled, spawn overrides, no AOT namespace).
+    supports_packing = False
+    packing_reason: Optional[str] = None
+
+    # Walk-truncation honesty (simulation backends): the number of walks
+    # aborted because their trace buffer overflowed (NOT a semantic
+    # depth cap). Nonzero means absence of discoveries on those walks is
+    # truncation, not evidence — the report loop warns once at run end.
+    _trace_overflows = 0
+
     def request_preempt(self) -> None:
         """Asks the worker to suspend at the next wave boundary and
         drain its state into an in-memory checkpoint payload. Device
@@ -577,6 +595,12 @@ class Checker(Generic[State, Action]):
             ]
             if undiscovered:
                 reporter.report_undiscovered(undiscovered)
+            # Truncated-walk honesty (simulation backends): silently
+            # aborted trace-buffer overflows must never read as
+            # absence of discoveries.
+            overflows = getattr(self, "_trace_overflows", 0)
+            if overflows:
+                reporter.report_truncation(overflows)
             # Bounded host-pass honesty: the discoveries() call above
             # already ran (and cached) the lasso pass, so the
             # inconclusive set is final here.

@@ -141,6 +141,25 @@ class CheckerBuilder:
 
         return TpuSimulationChecker(self, seed, lanes, **kwargs)
 
+    def spawn_swarm(self, seed: int, **kwargs):
+        """Swarm verification: the entire randomized-walk loop runs
+        device-resident — per-walk threefry PRNG streams, restart/
+        boundary/depth/terminal handling, property evaluation, and
+        discovery capture fused into one long jitted scan per wave —
+        with a device hash-table sample of walk fingerprints for an
+        honest unique-coverage estimate. For state spaces too large
+        even for the tiered store; preemptible, packable, and
+        seed-deterministic (README "Swarm verification"). Pass
+        ``seeds=`` (a packed-state pool, or a budget-exhausted
+        ``spawn_tpu_bfs`` preempt payload) for the frontier-seeded
+        hybrid mode. Reference simulation semantics: the run ends when
+        every property has a discovery or ``target_state_count`` is
+        reached — a model with a HOLDING ``always`` property needs a
+        walk-step target or it samples forever."""
+        from .swarm import SwarmChecker
+
+        return SwarmChecker(self, seed, **kwargs)
+
     def serve(self, address):
         """Starts the interactive Explorer web service (blocks)."""
         from .explorer import serve

@@ -51,6 +51,14 @@ class UniformChooser(Chooser):
 
 
 class SimulationChecker(Checker):
+    # Honest capability surface (the PR 12 convention): host threads
+    # have no resumable payload format and nothing to co-dispatch.
+    supports_preempt = False
+    supports_packing = False
+    packing_reason = (
+        "host-threaded walker (no shared device dispatch to pack into)"
+    )
+
     def __init__(self, options, seed: int, chooser: Chooser):
         model = options.model
         self._model = model

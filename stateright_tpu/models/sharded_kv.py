@@ -1,0 +1,320 @@
+"""Sharded key-value store with live key migration.
+
+The ROADMAP 6(b) "too big to enumerate" zoo entry: ``K`` keys spread
+over ``S`` shards, clients writing (bounded version counters), and a
+migration protocol that hands a key from its owner to a destination
+shard in two steps (``MigrateStart`` marks the key in flight,
+``MigrateComplete`` transfers ownership). The modeled bug — the swarm
+bench's known violation — is a write landing while the key's handoff is
+in flight: with ``guarded=False`` (the default) writes are accepted
+during migration and mark the key *torn* (the update can land on the
+old owner after the new owner took over), violating ``always "no torn
+writes"``. ``guarded=True`` refuses writes on in-flight keys, the fix.
+
+State-space scale: roughly ``S^K · (V+1)^K · (S+1)^K · 2^K`` upper
+bound. The parity config (S=2, K=2, V=1) is a few hundred reachable
+states — host/device equivalence is testable; the bench config
+(S=4, K=8, V=3) is ~10^14, far beyond the tiered store — the swarm's
+territory.
+
+Properties:
+- ``always "no torn writes"`` (antecedent: some migration in flight —
+  the coverage ledger flags a run that never exercised migration as a
+  vacuous pass). Violated when ``guarded=False`` at depth 2 (shallow).
+- ``always "no total tear"`` — EVERY key torn at once: the deep
+  violation (>= 2·K actions from init). At bench scale (K=8) the
+  breadth-first frontier explodes long before that depth, while a
+  random walk reaches it in one trace — the swarm-vs-exhaustive
+  time-to-first-violation leg.
+- ``sometimes "fully migrated"`` — every key left its home shard.
+- ``sometimes "saturated writes"`` — every key's version hit the cap.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..core.batch import BatchableModel
+from ..core.model import Model, Property
+
+# ``inflight`` sentinel: no migration for this key.
+_NONE = None
+
+
+@dataclass(frozen=True)
+class ShardedKvState:
+    owner: Tuple[int, ...]       # key -> owning shard
+    ver: Tuple[int, ...]         # key -> version counter
+    inflight: Tuple[Optional[int], ...]  # key -> destination shard | None
+    torn: Tuple[bool, ...]       # key -> a write raced its migration
+
+
+class ShardedKv(Model, BatchableModel):
+    """``S`` shards, ``K`` keys (home shard ``k % S``), versions bounded
+    by ``V``. ``guarded=True`` is the fixed protocol (no writes while a
+    key is in flight)."""
+
+    def __init__(self, shards: int = 2, keys: int = 2, max_version: int = 1,
+                 guarded: bool = False, retain=None):
+        if shards < 2:
+            raise ValueError("migration needs at least 2 shards")
+        self.S = int(shards)
+        self.K = int(keys)
+        self.V = int(max_version)
+        self.guarded = bool(guarded)
+        # Optional property filter (the actor models' retain_properties
+        # analog): keeps properties/conditions/antecedents aligned, so
+        # a bench leg can time exactly one violation hunt.
+        self._retain = (
+            tuple(retain)
+            if retain is not None and not isinstance(retain, str)
+            else ((retain,) if retain else None)
+        )
+
+    def _keep(self, items, props):
+        if self._retain is None:
+            return items
+        kept = [
+            x for p, x in zip(props, items) if p.name in self._retain
+        ]
+        if len(kept) != len(self._retain):
+            have = [p.name for p in props]
+            raise ValueError(
+                f"retain={self._retain!r} does not match properties "
+                f"{have!r}"
+            )
+        return kept
+
+    def _home(self, k: int) -> int:
+        return k % self.S
+
+    # -- host model ---------------------------------------------------------
+
+    def init_states(self) -> List[ShardedKvState]:
+        return [
+            ShardedKvState(
+                owner=tuple(self._home(k) for k in range(self.K)),
+                ver=(0,) * self.K,
+                inflight=(_NONE,) * self.K,
+                torn=(False,) * self.K,
+            )
+        ]
+
+    def actions(self, state: ShardedKvState, actions: List) -> None:
+        for k in range(self.K):
+            if state.ver[k] < self.V and (
+                not self.guarded or state.inflight[k] is _NONE
+            ):
+                actions.append(("Write", k))
+            if state.inflight[k] is _NONE:
+                for d in range(self.S):
+                    if d != state.owner[k]:
+                        actions.append(("MigrateStart", k, d))
+            else:
+                actions.append(("MigrateComplete", k))
+
+    def next_state(self, state: ShardedKvState, action) -> ShardedKvState:
+        kind, k = action[0], action[1]
+        owner = list(state.owner)
+        ver = list(state.ver)
+        inflight = list(state.inflight)
+        torn = list(state.torn)
+        if kind == "Write":
+            ver[k] += 1
+            if inflight[k] is not _NONE:
+                # The race: an accepted write while the key is mid-
+                # handoff can land on the retiring owner and vanish.
+                torn[k] = True
+        elif kind == "MigrateStart":
+            inflight[k] = action[2]
+        elif kind == "MigrateComplete":
+            owner[k] = inflight[k]
+            inflight[k] = _NONE
+        else:
+            raise ValueError(f"unknown action {action!r}")
+        return ShardedKvState(
+            owner=tuple(owner), ver=tuple(ver),
+            inflight=tuple(inflight), torn=tuple(torn),
+        )
+
+    def _all_properties(self) -> List[Property]:
+        return [
+            Property.always(
+                "no torn writes",
+                lambda _, s: not any(s.torn),
+                antecedent=lambda _, s: any(
+                    f is not _NONE for f in s.inflight
+                ),
+            ),
+            # The DEEP violation (swarm bench territory): every key
+            # torn at once sits >= 2K actions from init — beyond any
+            # breadth-first horizon at bench scale, trivial for a
+            # depth-first random walk.
+            Property.always(
+                "no total tear",
+                lambda _, s: not all(s.torn),
+                antecedent=lambda _, s: any(
+                    f is not _NONE for f in s.inflight
+                ),
+            ),
+            Property.sometimes(
+                "fully migrated",
+                lambda m, s: all(
+                    s.owner[k] != m._home(k) for k in range(m.K)
+                ),
+            ),
+            Property.sometimes(
+                "saturated writes",
+                lambda m, s: all(v == m.V for v in s.ver),
+            ),
+        ]
+
+    def properties(self) -> List[Property]:
+        props = self._all_properties()
+        return self._keep(props, props)
+
+    # -- BatchableModel (packed protocol) -----------------------------------
+    #
+    # Packed layout (all uint32, length-K vectors):
+    #   owner:    key -> owning shard
+    #   ver:      key -> version
+    #   inflight: key -> destination shard, S = none
+    #   torn:     key -> 0/1
+    #
+    # Dense action ids (A = K + K*S + K):
+    #   [0, K)           Write(k = aid)
+    #   [K, K + K*S)     MigrateStart(k = (aid-K) // S, d = (aid-K) % S)
+    #   [K + K*S, A)     MigrateComplete(k = aid - K - K*S)
+
+    def packed_action_count(self) -> int:
+        return self.K * (self.S + 2)
+
+    def packed_action_labels(self):
+        labels = [f"Write_{k}" for k in range(self.K)]
+        for k in range(self.K):
+            labels += [
+                f"MigrateStart_{k}_to_{d}" for d in range(self.S)
+            ]
+        labels += [f"MigrateComplete_{k}" for k in range(self.K)]
+        return labels
+
+    def packed_init_states(self):
+        import jax.numpy as jnp
+
+        K = self.K
+        return {
+            "owner": jnp.asarray(
+                [[self._home(k) for k in range(K)]], jnp.uint32
+            ),
+            "ver": jnp.zeros((1, K), jnp.uint32),
+            "inflight": jnp.full((1, K), self.S, jnp.uint32),
+            "torn": jnp.zeros((1, K), jnp.uint32),
+        }
+
+    def packed_step(self, state, action_id):
+        import jax.numpy as jnp
+
+        K, S = self.K, self.S
+        aid = action_id.astype(jnp.int32)
+        is_write = aid < K
+        is_start = (aid >= K) & (aid < K + K * S)
+        k = jnp.where(
+            is_write,
+            aid,
+            jnp.where(is_start, (aid - K) // S, aid - K - K * S),
+        )
+        k = jnp.clip(k, 0, K - 1)
+        d = jnp.clip((aid - K) % S, 0, S - 1).astype(jnp.uint32)
+
+        owner, ver = state["owner"], state["ver"]
+        inflight, torn = state["inflight"], state["torn"]
+        none = jnp.uint32(S)
+        key_free = inflight[k] == none
+        valid = jnp.where(
+            is_write,
+            (ver[k] < jnp.uint32(self.V))
+            & (jnp.bool_(not self.guarded) | key_free),
+            jnp.where(
+                is_start,
+                key_free & (d != owner[k]),
+                ~key_free,
+            ),
+        )
+
+        onehot = jnp.arange(K) == k
+        new_ver = jnp.where(
+            onehot & is_write, ver + jnp.uint32(1), ver
+        ).astype(jnp.uint32)
+        new_torn = jnp.where(
+            onehot & is_write & ~key_free, jnp.uint32(1), torn
+        ).astype(jnp.uint32)
+        new_inflight = jnp.where(
+            onehot & is_start,
+            d,
+            jnp.where(onehot & ~is_write & ~is_start, none, inflight),
+        ).astype(jnp.uint32)
+        new_owner = jnp.where(
+            onehot & ~is_write & ~is_start, inflight[k], owner
+        ).astype(jnp.uint32)
+        return {
+            "owner": new_owner,
+            "ver": new_ver,
+            "inflight": new_inflight,
+            "torn": new_torn,
+        }, valid
+
+    def packed_conditions(self):
+        import jax.numpy as jnp
+
+        home = jnp.asarray(
+            [self._home(k) for k in range(self.K)], jnp.uint32
+        )
+        conds = [
+            lambda st: ~(st["torn"] == 1).any(),
+            lambda st: ~(st["torn"] == 1).all(),
+            lambda st, h=home: (st["owner"] != h).all(),
+            lambda st: (st["ver"] == jnp.uint32(self.V)).all(),
+        ]
+        return self._keep(conds, self._all_properties())
+
+    def packed_antecedents(self):
+        import jax.numpy as jnp
+
+        def inflight_any(st):
+            return (st["inflight"] != jnp.uint32(self.S)).any()
+
+        return self._keep(
+            [inflight_any, inflight_any, None, None],
+            self._all_properties(),
+        )
+
+    def pack_state(self, host_state: ShardedKvState):
+        return {
+            "owner": np.asarray(host_state.owner, np.uint32),
+            "ver": np.asarray(host_state.ver, np.uint32),
+            "inflight": np.asarray(
+                [
+                    self.S if f is _NONE else f
+                    for f in host_state.inflight
+                ],
+                np.uint32,
+            ),
+            "torn": np.asarray(
+                [1 if t else 0 for t in host_state.torn], np.uint32
+            ),
+        }
+
+    def unpack_state(self, packed) -> ShardedKvState:
+        inflight = tuple(
+            _NONE if int(f) == self.S else int(f)
+            for f in np.asarray(packed["inflight"])
+        )
+        return ShardedKvState(
+            owner=tuple(int(o) for o in np.asarray(packed["owner"])),
+            ver=tuple(int(v) for v in np.asarray(packed["ver"])),
+            inflight=inflight,
+            torn=tuple(bool(t) for t in np.asarray(packed["torn"])),
+        )

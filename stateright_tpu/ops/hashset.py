@@ -125,6 +125,12 @@ def hashset_insert_unsorted(
     may contain DUPLICATE active keys in any order, and exactly one lane
     per distinct key reports ``fresh``.
 
+    Two consumers ride this variant: the scatter wave-dedup policy
+    below, and the swarm engine's visited-sample table
+    (``checker/swarm.py`` — walk fingerprints arrive unsorted and
+    duplicated by construction, and the exactly-one-fresh guarantee is
+    what makes ``unique_sample`` an honest distinct count).
+
     Same-key lanes attempt the same slot; the row-window claim alone
     cannot tell them apart (each re-reads its own key either way), so a
     table-sized *owner ticket* scratch — scatter-min of lane ids per slot

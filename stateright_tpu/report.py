@@ -50,6 +50,12 @@ class Reporter:
         mistaken for certified absence). Default no-op keeps existing
         reporters source-compatible."""
 
+    def report_truncation(self, overflows: int) -> None:
+        """Called once at run end (simulation backends) when walks were
+        silently aborted by a trace-buffer overflow — truncation must
+        never be mistaken for absence of discoveries. Default no-op
+        keeps existing reporters source-compatible."""
+
     def delay(self) -> float:
         """Seconds between progress reports."""
         return 1.0
@@ -104,6 +110,13 @@ class WriteReporter(Reporter):
                 "counterexamples NOT certified\n"
             )
 
+    def report_truncation(self, overflows: int) -> None:
+        self.writer.write(
+            f"Warning: {overflows} walk(s) truncated at the trace "
+            "buffer (raise max_trace_len); absence of discoveries on "
+            "those walks is NOT evidence\n"
+        )
+
 
 class TelemetryReporter(Reporter):
     """Renders telemetry metrics snapshots alongside (not instead of) an
@@ -154,6 +167,10 @@ class TelemetryReporter(Reporter):
                 inconclusive=inconclusive,
                 skipped_crashed=skipped_crashed,
             )
+
+    def report_truncation(self, overflows: int) -> None:
+        if self.inner is not None:
+            self.inner.report_truncation(overflows)
 
     def delay(self) -> float:
         return self.inner.delay() if self.inner is not None else 1.0

@@ -6,9 +6,14 @@ Extends the monitor/Explorer HTTP surface with the job API::
                                  {"model": "2pc", "model_args": {...},
                                   "options": {...}, "spawn": {...},
                                   "priority": 0, "deadline_s": null,
-                                  "tenant": "...", "hbm_budget_mib": null}
+                                  "tenant": "...", "hbm_budget_mib": null,
+                                  "mode": "exhaustive" | "swarm",
+                                  "seed": 0}
                                  (an inadmissible hbm_budget_mib is a 400
-                                 at submit, not a mid-run failure)
+                                 at submit, not a mid-run failure;
+                                 mode="swarm" runs seed-deterministic
+                                 randomized walks — see README "Swarm
+                                 verification")
     GET  /jobs                   every job's status (the UI panel feed)
     GET  /jobs/<id>              one job: state, verdict, latency fields,
                                  and the honest scheduling surface —
@@ -66,6 +71,20 @@ _HTTP_SPAWN_KEYS = frozenset({
     "expand_fps",
     "bucket_ladder",
     "attribution",
+    "coverage",
+})
+
+# Swarm fleet shape (mode="swarm" jobs; checker/swarm.py). Mode-keyed
+# so a wrong-mode spawn key stays a 400 AT SUBMIT (the module
+# convention), not a TypeError mid-run — an exhaustive job has no
+# "lanes", a swarm job no "bucket_ladder". Note a spawn override
+# honestly disqualifies a swarm job from packing.
+_HTTP_SWARM_SPAWN_KEYS = frozenset({
+    "lanes",
+    "wave_steps",
+    "max_trace_len",
+    "sample_capacity",
+    "sample_stride",
     "coverage",
 })
 
@@ -193,13 +212,17 @@ class _ServiceHandler(BaseHTTPRequestHandler):
         if not isinstance(spawn, dict):
             _json_response(self, {"error": "spawn must be an object"}, 400)
             return
-        blocked = set(spawn) - _HTTP_SPAWN_KEYS
+        mode = body.get("mode") or "exhaustive"
+        allowed = (
+            _HTTP_SWARM_SPAWN_KEYS if mode == "swarm" else _HTTP_SPAWN_KEYS
+        )
+        blocked = set(spawn) - allowed
         if blocked:
             _json_response(
                 self,
-                {"error": f"spawn keys not allowed over HTTP: "
-                          f"{sorted(blocked)}",
-                 "allowed": sorted(_HTTP_SPAWN_KEYS)},
+                {"error": f"spawn keys not allowed over HTTP for "
+                          f"mode={mode!r}: {sorted(blocked)}",
+                 "allowed": sorted(allowed)},
                 400,
             )
             return
@@ -226,6 +249,8 @@ class _ServiceHandler(BaseHTTPRequestHandler):
                 tenant=body.get("tenant"),
                 hbm_budget_mib=body.get("hbm_budget_mib"),
                 timeout_s=body.get("timeout_s"),
+                mode=body.get("mode") or "exhaustive",
+                seed=body.get("seed") or 0,
                 **submit_kwargs,
             )
         except QueueFullError as e:

@@ -125,6 +125,8 @@ class CheckJob:
         aot_namespace: Optional[str] = None,
         retry_policy: Optional[RetryPolicy] = None,
         timeout_s: Optional[float] = None,
+        mode: str = "exhaustive",
+        seed: int = 0,
         seq: int = 0,
         clock=time.monotonic,
     ):
@@ -141,6 +143,12 @@ class CheckJob:
         self.aot_namespace = aot_namespace
         self.retry_policy = retry_policy
         self.timeout_s = timeout_s
+        # Verification mode: "exhaustive" (device BFS over the full
+        # space) or "swarm" (device-width randomized walks — state
+        # spaces beyond the store; ``seed`` keys the reproducible walk
+        # streams and rides the journal/status).
+        self.mode = mode
+        self.seed = int(seed)
         self.seq = seq
         self._clock = clock
         self._lock = threading.Lock()
@@ -357,6 +365,8 @@ class CheckJob:
                 "deadline_s": self.deadline_s,
                 "hbm_budget_mib": self.hbm_budget_mib,
                 "timeout_s": self.timeout_s,
+                "mode": self.mode,
+                "seed": self.seed,
                 "state": self.state,
                 "durable": self.durable,
                 "preemptible": self.preemptible,

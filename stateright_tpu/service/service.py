@@ -104,6 +104,20 @@ _DEFAULT_SPAWN = {
     "max_drain_waves": 8,
 }
 
+# Spawn kwargs for mode="swarm" jobs (checker/swarm.py): one fleet shape
+# for every swarm job on the service, which is what lets them pack into
+# one stacked dispatch — and what makes a packed tenant's walks
+# bit-identical to the same job run solo.
+_DEFAULT_SWARM_SPAWN = {
+    "lanes": 512,
+    "wave_steps": 256,
+    "max_trace_len": 128,
+    "sample_capacity": 1 << 14,
+    "sample_stride": 1,
+}
+
+_JOB_MODES = ("exhaustive", "swarm")
+
 # Default job ids are unique across every service in the process (the
 # id is also the run_id, which keys process-global registries).
 _GLOBAL_JOB_SEQ = itertools.count()
@@ -149,6 +163,7 @@ class CheckService:
         default_spawn: Optional[dict] = None,
         default_hbm_budget_mib: Optional[float] = None,
         spawn_method: str = "spawn_tpu_bfs",
+        default_swarm_spawn: Optional[dict] = None,
         max_finished_jobs: int = 256,
         packing: bool = True,
         max_pack_tenants: int = 8,
@@ -168,6 +183,10 @@ class CheckService:
             self.default_spawn.update(default_spawn)
         self.default_hbm_budget_mib = default_hbm_budget_mib
         self.spawn_method = spawn_method
+        # mode="swarm" fleet shape (see _DEFAULT_SWARM_SPAWN).
+        self.default_swarm_spawn = dict(_DEFAULT_SWARM_SPAWN)
+        if default_swarm_spawn:
+            self.default_swarm_spawn.update(default_swarm_spawn)
         # Tenant-packed waves (checker/packed_tenancy.py): qualifying
         # same-shape jobs share one physical dispatch instead of
         # time-slicing. ``packing=False`` restores the pure time-slicer;
@@ -265,6 +284,8 @@ class CheckService:
         job_id: Optional[str] = None,
         timeout_s: Optional[float] = None,
         retry_policy: Optional[RetryPolicy] = "default",
+        mode: str = "exhaustive",
+        seed: int = 0,
     ) -> JobHandle:
         """Admits one check job; returns immediately with a handle.
 
@@ -275,9 +296,71 @@ class CheckService:
         namespace are configured identically). ``options`` takes the
         builder knobs (``target_state_count``, ``target_max_depth``,
         ``symmetry``); ``spawn`` any ``spawn_tpu_bfs`` kwarg;
-        ``hbm_budget_mib`` the tenant's device budget."""
+        ``hbm_budget_mib`` the tenant's device budget. ``mode="swarm"``
+        runs device-width randomized walks instead of exhaustive BFS
+        (state spaces beyond the store; ``seed`` keys the reproducible
+        walk streams — same seed, same verdict, packed or solo)."""
         if self._closing.is_set():
             raise RuntimeError("CheckService is closed")
+        if mode not in _JOB_MODES:
+            raise ValueError(
+                f"unknown mode {mode!r} (supported: {list(_JOB_MODES)})"
+            )
+        try:
+            seed = int(seed)
+        except (TypeError, ValueError):
+            raise ValueError("seed must be an integer") from None
+        if mode == "swarm" and hbm_budget_mib is not None:
+            raise ValueError(
+                "mode='swarm' has no tiered visited store to budget; "
+                "size the walk sample via default_swarm_spawn/"
+                "spawn={'sample_capacity': ...} instead"
+            )
+        if mode == "swarm" and (options or {}).get("symmetry"):
+            # Known-at-admission conflict: SwarmChecker refuses
+            # symmetry at spawn (cycle checks are host-only) — reject
+            # HERE, not as a mid-run failure burning retries.
+            raise ValueError(
+                "mode='swarm' does not support symmetry reduction "
+                "(walk cycle detection is host-only; use "
+                "spawn_simulation for symmetric models)"
+            )
+        if mode == "swarm":
+            # The walk carry holds targets as int32 runtime scalars —
+            # an out-of-range value is a known-at-admission config
+            # error (mid-run it would burn the retry budget on the
+            # packed path), same convention as the checks above.
+            for knob in ("target_state_count", "target_max_depth"):
+                v = (options or {}).get(knob)
+                if v is None:
+                    continue
+                try:
+                    v = int(v)
+                except (TypeError, ValueError):
+                    raise ValueError(
+                        f"{knob} must be an integer"
+                    ) from None
+                if not 0 < v < 2**31:
+                    raise ValueError(
+                        f"{knob}={v} is outside the int32 range the "
+                        "walk carry uses; split the budget across "
+                        "resumed runs"
+                    )
+        if mode == "swarm" and not (
+            (options or {}).get("target_state_count")
+            or timeout_s is not None
+        ):
+            # Another known-at-admission conflict: simulation semantics
+            # only stop when EVERY property has a discovery, so a model
+            # with a holding always-property samples forever — a job
+            # with no stop bound would occupy the device indefinitely,
+            # suspended and resumed every quantum.
+            raise ValueError(
+                "mode='swarm' needs a stop bound (a holding property "
+                "is never 'discovered', so an unbounded walk samples "
+                "forever): pass options={'target_state_count': N} "
+                "and/or timeout_s"
+            )
         for field_name, value in (
             ("model_args", model_args),
             ("options", options),
@@ -354,7 +437,10 @@ class CheckService:
                     "retry_policy must be a RetryPolicy, a dict of its "
                     "fields, or None"
                 )
-        if hbm_budget_mib is None:
+        if hbm_budget_mib is None and mode != "swarm":
+            # The service-wide default budget never applies to swarm
+            # jobs — their device footprint is the fixed fleet shape,
+            # not a growing visited table.
             hbm_budget_mib = self.default_hbm_budget_mib
         # Budget-derived table sizing, validated AT ADMISSION: an
         # over-budget request (the budget cannot fit even one worst-case
@@ -366,12 +452,17 @@ class CheckService:
             derived_table_capacity = self._validate_budget(
                 factory, aot_namespace, spawn, hbm_budget_mib
             )
-        packable, packable_reason = self._classify_packable(
-            aot_namespace=aot_namespace,
-            options=options,
-            spawn=spawn,
-            hbm_budget_mib=hbm_budget_mib,
-        )
+        if mode == "swarm":
+            packable, packable_reason = self._classify_packable_swarm(
+                aot_namespace=aot_namespace, options=options, spawn=spawn
+            )
+        else:
+            packable, packable_reason = self._classify_packable(
+                aot_namespace=aot_namespace,
+                options=options,
+                spawn=spawn,
+                hbm_budget_mib=hbm_budget_mib,
+            )
         with self._cond:
             if self.max_queued_jobs is not None:
                 # Bounded admission: graceful 429-style degradation
@@ -411,14 +502,20 @@ class CheckService:
                 aot_namespace=aot_namespace,
                 retry_policy=retry_policy,
                 timeout_s=timeout_s,
+                mode=mode,
+                seed=seed,
                 seq=seq,
                 clock=self._clock,
             )
-            job.preemptible = self.spawn_method in _PREEMPTIBLE_SPAWNS
+            job.preemptible = (
+                True
+                if mode == "swarm"  # SwarmChecker.supports_preempt
+                else self.spawn_method in _PREEMPTIBLE_SPAWNS
+            )
             job.packable = packable
             job.packable_reason = packable_reason
             job.liveness_mode, job.liveness_reason = (
-                self._classify_liveness(options, spawn)
+                self._classify_liveness(options, spawn, mode=mode)
             )
             job.derived_table_capacity = derived_table_capacity
             # The zoo kwargs, kept for the durable journal's
@@ -498,7 +595,7 @@ class CheckService:
         "liveness",
     })
 
-    def _classify_liveness(self, options, spawn):
+    def _classify_liveness(self, options, spawn, mode="exhaustive"):
         """The job's ``eventually``-verdict mode and, when the service
         must downgrade the request (backend without device liveness),
         the honest reason — the PR 12 ``packable_reason`` pattern, so
@@ -508,6 +605,14 @@ class CheckService:
             "liveness", self.default_spawn.get("liveness")
         )
         host_pass = bool((options or {}).get("complete_liveness"))
+        if mode == "swarm":
+            if requested == "device" or host_pass:
+                return "default", (
+                    "swarm walks are sampling-based: eventually "
+                    "verdicts come from walk-local traces (no edge "
+                    "store, no lasso pass) — absence is never certified"
+                )
+            return "default", None
         if requested == "device":
             if self.spawn_method in _DEVICE_LIVENESS_SPAWNS:
                 return "device", None
@@ -554,6 +659,25 @@ class CheckService:
             return False, "hbm_budget_mib (solo tiered run)"
         return True, None
 
+    def _classify_packable_swarm(self, *, aot_namespace, options, spawn):
+        """Swarm packability: lane blocks over one stacked dispatch
+        (``checker/swarm.SwarmPackedEngine``). Per-tenant depth caps
+        and state targets are runtime scalars, so — unlike exhaustive
+        packing — they do NOT disqualify; only a fleet-shape override
+        or symmetry does."""
+        if not self.packing:
+            return False, "packing disabled on this service"
+        if aot_namespace is None:
+            return False, "custom model (no AOT namespace to pack under)"
+        if spawn:
+            return False, (
+                f"spawn overrides {sorted(spawn)} (a packed swarm "
+                "shares one fleet shape)"
+            )
+        if (options or {}).get("symmetry"):
+            return False, "symmetry (host-only for walk cycle checks)"
+        return True, None
+
     # -- durable recovery (service_dir mode) --------------------------------
 
     def _durable_spec(self, job: CheckJob) -> Optional[dict]:
@@ -570,6 +694,8 @@ class CheckService:
         spec.update(
             options=job.options or None,
             spawn=job.spawn or None,
+            mode=job.mode,
+            seed=job.seed,
             priority=job.priority,
             deadline_s=job.deadline_s,
             tenant=job.tenant,
@@ -950,7 +1076,19 @@ class CheckService:
         self._journal_state(job)
 
     def _spawn(self, job: CheckJob):
-        model = job.model_factory()
+        if job.mode == "swarm":
+            # Per-namespace instance, not a fresh factory() call: the
+            # swarm wave-executable cache pins the model by IDENTITY, so
+            # a solo swarm job's compile-free second run (and every
+            # preempted job's next incarnation) depends on same-config
+            # spawns sharing one instance, exactly like the pack path.
+            model = self._model_for(job.model_factory, job.aot_namespace)
+        else:
+            # Exhaustive solo jobs keep their own instance: their AOT
+            # sharing is namespace+trace-signature keyed (identity-free),
+            # and sharing here would let a user-supplied namespace that
+            # lies about the configuration silently swap the model.
+            model = job.model_factory()
         builder = model.checker()
         opts = job.options
         if opts.get("target_state_count"):
@@ -959,6 +1097,21 @@ class CheckService:
             builder = builder.target_max_depth(opts["target_max_depth"])
         if opts.get("symmetry"):
             builder = builder.symmetry()
+        if job.mode == "swarm":
+            # Swarm jobs spawn the device-resident walker regardless of
+            # the service's exhaustive spawn_method; their spawn surface
+            # is the fleet shape, not the BFS knobs.
+            spawn = dict(self.default_swarm_spawn)
+            spawn.update(job.spawn)
+            spawn["run_id"] = job.run_id
+            if job.aot_namespace is not None:
+                spawn.setdefault(
+                    "aot_cache", f"swarm:{job.aot_namespace}"
+                )
+            if job.payload is not None:
+                spawn["resume_from"] = job.payload
+                job.payload = None
+            return builder.spawn_swarm(seed=job.seed, **spawn)
         if opts.get("complete_liveness"):
             builder = builder.complete_liveness(
                 budget_states=opts.get("liveness_budget_states"),
@@ -1215,9 +1368,11 @@ class CheckService:
 
     # -- the packer (tenant-packed waves) -----------------------------------
 
-    def _pack_peers(self, key: str, members: Dict[str, CheckJob]):
-        """Runnable packable same-configuration jobs not yet in the pack
-        — the admission candidates, best-first."""
+    def _pack_peers(self, key: str, members: Dict[str, CheckJob],
+                    mode: str = "exhaustive"):
+        """Runnable packable same-configuration same-mode jobs not yet
+        in the pack — the admission candidates, best-first. (A swarm
+        fleet and an exhaustive wave cannot share a dispatch.)"""
         with self._cond:
             peers = [
                 j
@@ -1227,11 +1382,13 @@ class CheckService:
                 and not j.cancel_event.is_set()
                 and j.packable
                 and j.aot_namespace == key
+                and j.mode == mode
             ]
         return sorted(peers, key=lambda j: j.sort_key())
 
     def _pack_contender(self, key: str, members: Dict[str, CheckJob],
-                        can_join: bool) -> bool:
+                        can_join: bool,
+                        mode: str = "exhaustive") -> bool:
         """Whether a runnable job OUTSIDE the pack — one that cannot
         simply join it — sorts ahead of where the pack's best member
         would re-enter the queue. Same honesty rule as
@@ -1251,7 +1408,10 @@ class CheckService:
                 and j.runnable()
                 and not j.cancel_event.is_set()
                 and not (
-                    can_join and j.packable and j.aot_namespace == key
+                    can_join
+                    and j.packable
+                    and j.aot_namespace == key
+                    and j.mode == mode
                 )
                 and j.sort_key() < reentry
                 for j in self._jobs.values()
@@ -1262,12 +1422,22 @@ class CheckService:
         payload slice, if any); stamps the membership clocks only AFTER
         the admission succeeds — a failed admit must not leave the job
         reporting packed:true with a counted slice."""
-        view = engine.admit(
-            job.job_id,
-            job.run_id,
-            depth_cap=job.options.get("target_max_depth"),
-            resume_from=job.payload,
-        )
+        if job.mode == "swarm":
+            view = engine.admit(
+                job.job_id,
+                job.run_id,
+                seed=job.seed,
+                depth_cap=job.options.get("target_max_depth"),
+                target_state_count=job.options.get("target_state_count"),
+                resume_from=job.payload,
+            )
+        else:
+            view = engine.admit(
+                job.job_id,
+                job.run_id,
+                depth_cap=job.options.get("target_max_depth"),
+                resume_from=job.payload,
+            )
         job.payload = None
         job.state = JOB_RUNNING
         job.slices += 1
@@ -1339,42 +1509,58 @@ class CheckService:
         only when an outside contender would actually be picked.
         Strictly serialized with every other slice — the device still
         has exactly one claimant."""
-        from ..checker.packed_tenancy import TenantPackedEngine
-
         key = lead.aot_namespace
+        mode = lead.mode
         spawn = dict(self.default_spawn)
         model = self._model_for(lead.model_factory, key)
-        founders = [lead, *self._pack_peers(key, {})]
-        base_table = spawn.get("table_capacity", 1 << 16)
-        # Size the shared table for the founding fleet up front: K
-        # tenants' visited sets share one table, and pre-sizing avoids
-        # the growth rehashes (and their per-shape compiles) a
-        # per-tenant-sized table would churn through mid-pack.
-        m = 1
-        while m < min(len(founders), self.max_pack_tenants):
-            m *= 2
-        engine = TenantPackedEngine(
-            model,
-            frontier_capacity=spawn.get("frontier_capacity", 1 << 10),
-            table_capacity=base_table * m,
-            max_tenants=self.max_pack_tenants,
-            # Packed waves are occupancy-dense by construction (that is
-            # the point of packing) — the bucket ladder would only buy
-            # a compile shape per rung for the few ramp-up waves.
-            bucket_ladder=0,
-            aot_cache=f"pack:{key}",
-            resume_capacity=base_table,
-            # The service knob, or a service-wide async default (a
-            # pack-safe default_spawn key) — either opts the pack's
-            # host half onto the pipeline worker.
-            async_pipeline=(
-                self.pack_async
-                or bool(spawn.get("async_pipeline"))
-            ),
-            # Pack-safe service-wide knob: per-tenant edge partitions
-            # keep each member's verdict identical to its solo run's.
-            liveness=spawn.get("liveness"),
-        )
+        founders = [lead, *self._pack_peers(key, {}, mode)]
+        if mode == "swarm":
+            # Swarm packs: lane blocks over one stacked walk dispatch —
+            # no shared table, no salting; every tenant's verdict is
+            # the solo run's by vmap construction (checker/swarm.py).
+            from ..checker.swarm import SwarmPackedEngine
+
+            engine = SwarmPackedEngine(
+                model,
+                max_tenants=self.max_pack_tenants,
+                aot_cache=f"swarmpack:{key}",
+                **self.default_swarm_spawn,
+            )
+        else:
+            from ..checker.packed_tenancy import TenantPackedEngine
+
+            base_table = spawn.get("table_capacity", 1 << 16)
+            # Size the shared table for the founding fleet up front: K
+            # tenants' visited sets share one table, and pre-sizing
+            # avoids the growth rehashes (and their per-shape compiles)
+            # a per-tenant-sized table would churn through mid-pack.
+            m = 1
+            while m < min(len(founders), self.max_pack_tenants):
+                m *= 2
+            engine = TenantPackedEngine(
+                model,
+                frontier_capacity=spawn.get("frontier_capacity", 1 << 10),
+                table_capacity=base_table * m,
+                max_tenants=self.max_pack_tenants,
+                # Packed waves are occupancy-dense by construction (that
+                # is the point of packing) — the bucket ladder would
+                # only buy a compile shape per rung for the few ramp-up
+                # waves.
+                bucket_ladder=0,
+                aot_cache=f"pack:{key}",
+                resume_capacity=base_table,
+                # The service knob, or a service-wide async default (a
+                # pack-safe default_spawn key) — either opts the pack's
+                # host half onto the pipeline worker.
+                async_pipeline=(
+                    self.pack_async
+                    or bool(spawn.get("async_pipeline"))
+                ),
+                # Pack-safe service-wide knob: per-tenant edge
+                # partitions keep each member's verdict identical to
+                # its solo run's.
+                liveness=spawn.get("liveness"),
+            )
         members: Dict[str, CheckJob] = {}
         views: Dict[str, object] = {}
         snapshots: Dict[str, Optional[dict]] = {}
@@ -1416,7 +1602,7 @@ class CheckService:
                 if not members:
                     return
                 if engine.free_slots():
-                    for job in self._pack_peers(key, members):
+                    for job in self._pack_peers(key, members, mode):
                         if engine.free_slots() == 0:
                             break
                         self._try_pack_admit(
@@ -1425,7 +1611,7 @@ class CheckService:
                 if (
                     self._clock() >= slice_end
                     and self._pack_contender(
-                        key, members, engine.free_slots() > 0
+                        key, members, engine.free_slots() > 0, mode
                     )
                 ):
                     self._suspend_pack(engine, members, views)
@@ -1437,7 +1623,9 @@ class CheckService:
                     if (
                         tf is not None
                         and tf.tenant_key in members
-                        and not self.pack_async
+                        # Swarm packs have no async host half — tenant
+                        # attribution holds regardless of pack_async.
+                        and (mode == "swarm" or not self.pack_async)
                     ):
                         # PACK-LOCAL BLAST RADIUS: the engine rolled
                         # every faulted tenant back to its pre-wave

@@ -61,6 +61,15 @@ def _single_copy(clients=4, servers=1, **kw):
     return SingleCopyModelCfg(int(clients), int(servers), **kw).into_model()
 
 
+def _sharded_kv(shards=2, keys=2, max_version=1, guarded=False, **kw):
+    from ..models.sharded_kv import ShardedKv
+
+    return ShardedKv(
+        int(shards), int(keys), int(max_version), guarded=bool(guarded),
+        **kw,
+    )
+
+
 def default_zoo() -> Dict[str, Callable]:
     """Name -> model factory for the HTTP front-end (the bench legs'
     model set). Import-light: factories import their model lazily."""
@@ -73,6 +82,10 @@ def default_zoo() -> Dict[str, Callable]:
         "increment_lock": _increment_lock,
         "raft": _raft,
         "single_copy_register": _single_copy,
+        # ROADMAP 6(b) zoo growth: the too-big-to-enumerate swarm
+        # workload (S=4, keys=8 is ~10^14 states; the default config is
+        # the exhaustively-checkable parity size).
+        "sharded_kv": _sharded_kv,
     }
 
 

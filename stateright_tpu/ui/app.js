@@ -251,6 +251,15 @@ async function refreshMonitorStatus() {
     const acov = m["monitor.coverage.action_coverage"];
     if (acov !== null && acov !== undefined)
       $("mon-action-cov").textContent = (100 * acov).toFixed(0) + "%";
+    // Swarm runs: the unique-coverage sample (distinct walk
+    // fingerprints; "≥" once the fixed-capacity sample table saturated
+    // — the estimate is then an honest lower bound).
+    const swarmUnique = pick("swarm.unique_sample");
+    if (swarmUnique !== null) {
+      const sat = m["swarm.sample_saturated"];
+      $("mon-swarm").textContent =
+        (sat ? "≥" : "") + fmtNum(swarmUnique) + " uniq";
+    }
     renderCoverageBars(m);
     const p = s.progress || {};
     if (p.max_depth !== null && p.max_depth !== undefined)

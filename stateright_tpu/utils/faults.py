@@ -190,6 +190,11 @@ _SITE_EXC = {
     "pack.tenant.verdict": PackTenantFault,
     "pack.tenant.evict": PackTenantFault,
     "liveness.edge_evict": LivenessEvictFault,
+    # Swarm engine seams (checker/swarm.py): the stacked wave dispatch
+    # and the per-tenant harvest that bounds a packed swarm's blast
+    # radius.
+    "swarm.wave": DeviceWaveFault,
+    "swarm.tenant.verdict": PackTenantFault,
 }
 
 # Sites that exist in the tree — fail fast on typos in test specs.

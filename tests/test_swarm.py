@@ -1,0 +1,686 @@
+"""Swarm verification engine (checker/swarm.py): seeded determinism,
+preempt/resume and packed-vs-solo bit-identity, the frontier-seeded
+hybrid handoff, service mode="swarm" integration, and the sharded-KV
+zoo model's host/device parity.
+
+The determinism contract under test is the acceptance criterion: same
+seed => bit-identical discoveries and walk counts across
+``wave_steps`` chunking, across preempt/resume, and packed vs solo —
+the stop decision lives INSIDE the fused scan, so wave boundaries can
+never influence results.
+"""
+
+import io
+
+import pytest
+
+from stateright_tpu.checker.swarm import (
+    SwarmPackedEngine,
+    frontier_seeds_from_payload,
+)
+from stateright_tpu.models.sharded_kv import ShardedKv
+from stateright_tpu.models.two_phase_commit import TwoPhaseSys
+
+# One model instance + AOT namespace for the whole module: the wave-fn
+# cache keys on model IDENTITY (checker/swarm.py), so same-shape tests
+# reuse one compiled scan instead of paying ~2s of jit each (the
+# tier-1 budget rule).
+MODEL_2PC3 = TwoPhaseSys(3)
+SWARM_KW = dict(lanes=64, sample_capacity=1 << 12, aot_cache="t-swarm")
+
+
+def _fingerprint_result(ck):
+    """Everything the determinism contract covers, as one comparable
+    value: discovery fingerprint trails, walk/step counts, the coverage
+    sample, and depth."""
+    return (
+        ck.state_count(),
+        ck.unique_state_count(),
+        ck.max_depth(),
+        dict(ck._discoveries_fps),
+        ck.coverage_estimate()["saturated"],
+    )
+
+
+def _solo(seed, wave_steps=32, target=20_000, **kw):
+    ck = (
+        MODEL_2PC3
+        .checker()
+        .target_state_count(target)
+        .spawn_swarm(seed=seed, wave_steps=wave_steps, **SWARM_KW, **kw)
+        .join()
+    )
+    assert ck.worker_error() is None
+    return ck
+
+
+def test_swarm_finds_sometimes_properties():
+    ck = _solo(seed=7, target=50_000)
+    paths = ck.discoveries()
+    assert "abort agreement" in paths and "commit agreement" in paths
+    for name, path in paths.items():
+        final = path.last_state()
+        if name == "abort agreement":
+            assert all(s == "Aborted" for s in final.rm_state)
+        if name == "commit agreement":
+            assert all(s == "Committed" for s in final.rm_state)
+
+
+def test_swarm_unique_sample_is_honest():
+    # 2pc-3 has 288 reachable states; an unsaturated sample must never
+    # exceed that, and the walk-step total is not the unique count.
+    ck = _solo(seed=7, target=50_000)
+    est = ck.coverage_estimate()
+    assert not est["saturated"]
+    assert 0 < ck.unique_state_count() <= 288
+    assert ck.state_count() >= 50_000 > ck.unique_state_count()
+
+
+def test_swarm_deterministic_across_wave_steps():
+    a = _fingerprint_result(_solo(seed=11, wave_steps=16))
+    b = _fingerprint_result(_solo(seed=11, wave_steps=128))
+    assert a == b
+
+
+def test_swarm_deterministic_across_preempt_resume():
+    import time
+
+    reference = _fingerprint_result(_solo(seed=11, wave_steps=16))
+    ck = (
+        MODEL_2PC3
+        .checker()
+        .target_state_count(20_000)
+        .spawn_swarm(seed=11, wave_steps=16, **SWARM_KW)
+    )
+    time.sleep(0.05)
+    ck.request_preempt()
+    ck.join()
+    if not ck.preempted:
+        pytest.skip("run finished before the preempt landed")
+    resumed = (
+        MODEL_2PC3
+        .checker()
+        .target_state_count(20_000)
+        .spawn_swarm(
+            seed=11, wave_steps=16, resume_from=ck.preempt_payload(),
+            **SWARM_KW,
+        )
+        .join()
+    )
+    assert resumed.worker_error() is None
+    assert _fingerprint_result(resumed) == reference
+
+
+def test_swarm_packed_vs_solo_bit_identical():
+    model = MODEL_2PC3
+    eng = SwarmPackedEngine(
+        model, lanes=64, wave_steps=16, max_trace_len=512,
+        sample_capacity=1 << 12, max_tenants=2,
+    )
+    v1 = eng.admit("j1", seed=11, target_state_count=20_000)
+    v2 = eng.admit("j2", seed=12, target_state_count=20_000)
+    done = set()
+    for _ in range(500):
+        done |= set(eng.step())
+        if len(done) == 2:
+            break
+    assert done == {"j1", "j2"}
+    for view, seed in ((v1, 11), (v2, 12)):
+        solo = _solo(seed=seed, wave_steps=16)
+        assert (
+            view.state_count(),
+            view.unique_state_count(),
+            view.max_depth(),
+            dict(view._fps),
+        ) == (
+            solo.state_count(),
+            solo.unique_state_count(),
+            solo.max_depth(),
+            dict(solo._discoveries_fps),
+        )
+        # Packed discovery paths replay through the host model too.
+        for path in view.discoveries().values():
+            assert len(path) >= 1
+    eng.release("j1")
+    eng.release("j2")
+
+
+def test_swarm_pack_drop_resumes_solo_bit_identical():
+    model = MODEL_2PC3
+    eng = SwarmPackedEngine(
+        model, lanes=64, wave_steps=16, max_trace_len=512,
+        sample_capacity=1 << 12, max_tenants=2,
+    )
+    eng.admit("j1", seed=11, target_state_count=20_000)
+    eng.step()  # one wave in the pack
+    payload = eng.drop("j1")
+    assert payload is not None and payload["kind"] == "swarm"
+    resumed = (
+        MODEL_2PC3
+        .checker()
+        .target_state_count(20_000)
+        .spawn_swarm(
+            seed=11, wave_steps=16, resume_from=payload, **SWARM_KW
+        )
+        .join()
+    )
+    assert resumed.worker_error() is None
+    assert _fingerprint_result(resumed) == _fingerprint_result(
+        _solo(seed=11, wave_steps=16)
+    )
+
+
+def test_swarm_finds_violation_exhaustive_confirms():
+    # The known-violation hunt: the unguarded sharded KV's torn-write
+    # race. The swarm must find it, the exhaustive checker must agree
+    # it exists, and the swarm's counterexample must replay to a
+    # genuinely torn state.
+    swarm = (
+        ShardedKv(2, 2, 1, guarded=False)
+        .checker()
+        .target_state_count(100_000)
+        .spawn_swarm(seed=5, wave_steps=32, **SWARM_KW)
+        .join()
+    )
+    assert swarm.worker_error() is None
+    path = swarm.discoveries().get("no torn writes")
+    assert path is not None, "swarm missed the torn-write violation"
+    assert any(path.last_state().torn)
+    exhaustive = (
+        ShardedKv(2, 2, 1, guarded=False).checker().spawn_bfs().join()
+    )
+    assert "no torn writes" in exhaustive.discoveries()
+
+
+def test_swarm_hybrid_frontier_seeding():
+    import time
+
+    # A budget-exhausted exhaustive run hands its live frontier to the
+    # swarm as restart seeds; seeded discoveries replay as fragments
+    # from their seed state.
+    bfs = MODEL_2PC3.checker().spawn_tpu_bfs(
+        frontier_capacity=1 << 6, table_capacity=1 << 10,
+        max_drain_waves=1,
+    )
+    bfs.request_preempt()
+    time.sleep(0.02)
+    bfs.join()
+    if not bfs.preempted:
+        pytest.skip("exhaustive run finished before the preempt landed")
+    payload = bfs.preempt_payload()
+    seeds = frontier_seeds_from_payload(MODEL_2PC3, payload)
+    ck = (
+        MODEL_2PC3
+        .checker()
+        .target_state_count(30_000)
+        .spawn_swarm(seed=9, wave_steps=32, seeds=seeds, **SWARM_KW)
+        .join()
+    )
+    assert ck.worker_error() is None
+    # Spawning straight from the payload dict is the one-liner form.
+    ck2 = (
+        MODEL_2PC3
+        .checker()
+        .target_state_count(5_000)
+        .spawn_swarm(seed=9, wave_steps=32, seeds=payload, **SWARM_KW)
+        .join()
+    )
+    assert ck2.worker_error() is None
+    for path in ck.discoveries().values():
+        assert len(path) >= 1  # replays from its seed state
+
+
+def test_swarm_trace_overflow_counted_and_reported():
+    # Walks deeper than the trace buffer (no user depth cap) are
+    # truncated: counted, and warned about at run end.
+    ck = (
+        MODEL_2PC3
+        .checker()
+        .target_state_count(5_000)
+        .spawn_swarm(
+            seed=3, wave_steps=32, max_trace_len=4, lanes=64,
+            sample_capacity=1 << 12,
+        )
+        .join()
+    )
+    assert ck.worker_error() is None
+    assert ck._trace_overflows > 0
+    snap = ck.metrics().snapshot()
+    assert snap.get("swarm.trace_overflow", 0) > 0
+    out = io.StringIO()
+    from stateright_tpu.report import WriteReporter
+
+    ck.report(WriteReporter(out))
+    assert "truncated at the trace buffer" in out.getvalue()
+
+
+def test_swarm_no_overflow_under_semantic_depth_cap():
+    # A user depth cap IS the buffer bound: capped walks are a semantic
+    # choice, not truncation — no warning, no counter.
+    ck = (
+        MODEL_2PC3
+        .checker()
+        .target_max_depth(4)
+        .target_state_count(3_000)
+        .spawn_swarm(seed=3, wave_steps=16, **SWARM_KW)
+        .join()
+    )
+    assert ck.worker_error() is None
+    assert ck.max_depth() <= 4
+    assert ck._trace_overflows == 0
+
+
+def test_swarm_coverage_ledger_counts_walk_actions():
+    ck = (
+        MODEL_2PC3
+        .checker()
+        .target_state_count(20_000)
+        .spawn_swarm(seed=7, wave_steps=32, coverage=True, **SWARM_KW)
+        .join()
+    )
+    assert ck.worker_error() is None
+    rep = ck.coverage_report()
+    assert rep is not None
+    table = rep["actions"]["table"]
+    assert table["TmAbort"]["fired"] > 0
+    assert table["RmPrepare_0"]["fired"] > 0
+    # 2pc-3's actions are all live in the reachable space; a healthy
+    # walk budget fires every one of them.
+    assert rep["vacuity"]["dead_actions"] == []
+
+
+def test_swarm_coverage_resume_does_not_double_count():
+    # The restored carry's cov vector is cumulative; the previous
+    # incarnation already consumed it into the run_id's registry, so a
+    # resume must baseline its delta there — not re-inc the whole
+    # prefix (regression: resumed coverage runs inflated action_fired).
+    from stateright_tpu.telemetry import metrics_registry
+
+    def fired_total(run_id):
+        reg = metrics_registry(run_id)
+        return sum(
+            value
+            for name, value in reg.snapshot().items()
+            if name.startswith("swarm.coverage.action_fired.")
+        )
+
+    ck = (
+        MODEL_2PC3
+        .checker()
+        .target_state_count(20_000)
+        .spawn_swarm(
+            seed=13, wave_steps=16, coverage=True, run_id="t-swarm-cov-a",
+            **SWARM_KW,
+        )
+        .join()
+    )
+    assert ck.worker_error() is None
+    reference = fired_total("t-swarm-cov-a")
+    assert reference > 0
+
+    import time
+
+    first = (
+        MODEL_2PC3
+        .checker()
+        .target_state_count(20_000)
+        .spawn_swarm(
+            seed=13, wave_steps=16, coverage=True, run_id="t-swarm-cov-b",
+            **SWARM_KW,
+        )
+    )
+    time.sleep(0.05)
+    first.request_preempt()
+    first.join()
+    if not first.preempted:
+        pytest.skip("run finished before the preempt landed")
+    resumed = (
+        MODEL_2PC3
+        .checker()
+        .target_state_count(20_000)
+        .spawn_swarm(
+            seed=13, wave_steps=16, coverage=True, run_id="t-swarm-cov-b",
+            resume_from=first.preempt_payload(), **SWARM_KW,
+        )
+        .join()
+    )
+    assert resumed.worker_error() is None
+    # The walk sequence is bit-identical (the determinism contract), so
+    # the run-scoped registry totals must match exactly — any excess is
+    # the pre-preempt prefix counted twice.
+    assert fired_total("t-swarm-cov-b") == reference
+
+
+def test_swarm_rejections():
+    with pytest.raises(NotImplementedError):
+        MODEL_2PC3.checker().symmetry().spawn_swarm(seed=1)
+    from stateright_tpu import FnModel
+
+    def fn(prev, out):
+        if prev is None:
+            out.append(0)
+
+    with pytest.raises(TypeError):
+        FnModel(fn).checker().spawn_swarm(seed=1)
+    # Resuming a swarm payload into a different fleet shape is refused.
+    ck = (
+        MODEL_2PC3
+        .checker()
+        .target_state_count(2_000)
+        .spawn_swarm(seed=1, wave_steps=8, **SWARM_KW)
+    )
+    ck.request_preempt()
+    ck.join()
+    if ck.preempted:
+        with pytest.raises(ValueError):
+            MODEL_2PC3.checker().spawn_swarm(
+                seed=1, wave_steps=8, lanes=128,
+                sample_capacity=1 << 12,
+                resume_from=ck.preempt_payload(),
+            )
+
+
+# -- service integration ----------------------------------------------------
+
+
+def test_service_swarm_jobs_pack_and_match_solo():
+    from stateright_tpu.service.service import CheckService
+
+    svc = CheckService(quantum_s=10.0)
+    try:
+        h1 = svc.submit(
+            model_name="2pc", model_args={"rm_count": 3},
+            options={"target_state_count": 10_000},
+            mode="swarm", seed=21,
+        )
+        h2 = svc.submit(
+            model_name="2pc", model_args={"rm_count": 3},
+            options={"target_state_count": 10_000},
+            mode="swarm", seed=22,
+        )
+        r1 = h1.result(timeout=180)
+        r2 = h2.result(timeout=180)
+        s1, s2 = h1.status(), h2.status()
+        assert s1["mode"] == "swarm" and s1["seed"] == 21
+        assert s1["packable"] and s2["packable"]
+        assert s1["packed"] or s2["packed"]
+        assert s1["preemptible"] is True
+        # Packed verdicts == the solo run at the service's fleet shape.
+        solo = (
+            MODEL_2PC3
+            .checker()
+            .target_state_count(10_000)
+            .spawn_swarm(
+                seed=21,
+                **{
+                    k: v
+                    for k, v in svc.default_swarm_spawn.items()
+                },
+            )
+            .join()
+        )
+        assert r1["states"] == solo.state_count()
+        assert r1["unique"] == solo.unique_state_count()
+        # Discovery sets match the solo run too (which ones were found
+        # is workload-dependent at this small target; identity is the
+        # contract).
+        assert sorted(r1["discoveries"]) == sorted(
+            solo._discoveries_fps
+        )
+        assert r2["states"] > 0
+    finally:
+        svc.close()
+
+
+def test_service_swarm_classification_and_rejections():
+    from stateright_tpu.service.service import CheckService
+
+    svc = CheckService()
+    try:
+        with pytest.raises(ValueError):
+            svc.submit(model_name="2pc", mode="warm")  # typo'd mode
+        with pytest.raises(ValueError):
+            svc.submit(
+                model_name="2pc", mode="swarm", hbm_budget_mib=64
+            )
+        with pytest.raises(ValueError):
+            # Known-at-admission conflict: rejected at submit, not as
+            # a retried mid-run NotImplementedError.
+            svc.submit(
+                model_name="2pc", mode="swarm",
+                options={"symmetry": True},
+            )
+        with pytest.raises(ValueError):
+            # No stop bound at all: 2pc's holding always-property is
+            # never "discovered", so the walk would sample forever —
+            # rejected at submit, not left occupying the device.
+            svc.submit(model_name="2pc", mode="swarm")
+        with pytest.raises(ValueError):
+            # int32 walk-carry range enforced at admission, not as a
+            # mid-run failure burning the packed path's retry budget.
+            svc.submit(
+                model_name="2pc", mode="swarm",
+                options={"target_state_count": 2**31},
+            )
+        # timeout_s alone is an acceptable bound (the job would end
+        # with partial-progress evidence instead of running unbounded);
+        # cancel right away — admission is what's under test.
+        svc.submit(
+            model_name="2pc", mode="swarm", timeout_s=30.0,
+        ).cancel()
+        h = svc.submit(
+            model_name="2pc", model_args={"rm_count": 3},
+            options={"target_state_count": 2_000},
+            spawn={"lanes": 32, "sample_capacity": 1 << 10},
+            mode="swarm", seed=1,
+        )
+        st = h.status()
+        # A fleet-shape override honestly disqualifies packing.
+        assert st["packable"] is False
+        assert "spawn overrides" in st["packable_reason"]
+        assert h.result(timeout=120)["states"] > 0
+    finally:
+        svc.close()
+
+
+def test_swarm_pack_same_wave_fault_does_not_lose_completion():
+    # Tenant A finishes in the SAME wave whose harvest faults for B:
+    # the raised TenantFaultError discards that step()'s done list, so
+    # A's completion must stay reportable (and A must keep counting as
+    # live) or the service strands a finished job in RUNNING forever.
+    from stateright_tpu.utils.faults import (
+        FaultSpec,
+        TenantFaultError,
+        inject,
+    )
+
+    eng = SwarmPackedEngine(
+        MODEL_2PC3, lanes=64, wave_steps=64, max_trace_len=512,
+        sample_capacity=1 << 12, max_tenants=2,
+    )
+    eng.admit("A", seed=11, target_state_count=100)  # stops in wave 1
+    eng.admit("B", seed=12, target_state_count=1_000_000)
+    with inject(FaultSpec("swarm.tenant.verdict", at=0, tenant="B")):
+        with pytest.raises(TenantFaultError):
+            eng.step()
+    eng.drop("B")  # what the service's blast-radius handler does
+    assert eng.live_count() >= 1
+    assert "A" in eng.step()
+    eng.release("A")
+    assert eng.free_slots() == 2
+
+
+def test_swarm_rejects_int32_overflowing_target():
+    with pytest.raises(ValueError):
+        (MODEL_2PC3.checker().target_state_count(2**31)
+         .spawn_swarm(seed=1, **SWARM_KW))
+
+
+def test_swarm_pack_tenant_fault_blast_radius():
+    from stateright_tpu.service.service import CheckService
+    from stateright_tpu.utils.faults import FaultSpec, inject
+
+    # A per-tenant harvest fault drops ONLY that tenant (it retries
+    # from its wave boundary); the surviving tenant's verdict is still
+    # bit-identical to its solo run.
+    with inject(
+        FaultSpec("swarm.tenant.verdict", at=0, tenant="fault-job")
+    ):
+        svc = CheckService(quantum_s=10.0)
+        try:
+            h1 = svc.submit(
+                model_name="2pc", model_args={"rm_count": 3},
+                options={"target_state_count": 10_000},
+                mode="swarm", seed=21, job_id="fault-job",
+            )
+            h2 = svc.submit(
+                model_name="2pc", model_args={"rm_count": 3},
+                options={"target_state_count": 10_000},
+                mode="swarm", seed=22,
+            )
+            r1 = h1.result(timeout=180)
+            r2 = h2.result(timeout=180)
+            assert h1.status()["retries"] >= 1
+            solo = (
+                MODEL_2PC3
+                .checker()
+                .target_state_count(10_000)
+                .spawn_swarm(
+                    seed=21, **dict(svc.default_swarm_spawn)
+                )
+                .join()
+            )
+            # The faulted job recovered to the exact solo verdict.
+            assert r1["states"] == solo.state_count()
+            assert r1["unique"] == solo.unique_state_count()
+            assert r2["states"] > 0
+        finally:
+            svc.close()
+
+
+# -- honest capability surfacing --------------------------------------------
+
+
+def test_simulation_backends_report_capabilities():
+    from stateright_tpu.checker.simulation import SimulationChecker
+    from stateright_tpu.checker.swarm import SwarmChecker
+    from stateright_tpu.checker.tpu_simulation import TpuSimulationChecker
+
+    assert SwarmChecker.supports_preempt is True
+    assert SwarmChecker.supports_packing is True
+    for cls in (SimulationChecker, TpuSimulationChecker):
+        assert cls.supports_preempt is False
+        assert cls.supports_packing is False
+        assert cls.packing_reason
+
+
+def test_swarm_wave_cache_keys_on_model_identity():
+    # Same aot_cache namespace + identical packed SHAPES but different
+    # transition logic (guarded vs unguarded ShardedKv) must never
+    # share a compiled wave fn — the guarded model verified with the
+    # unguarded kernel would report a violation against the fixed
+    # protocol.
+    unguarded = (
+        ShardedKv(2, 2, 1, guarded=False)
+        .checker()
+        .target_state_count(50_000)
+        .spawn_swarm(seed=5, wave_steps=32, aot_cache="t-collide",
+                     lanes=64, sample_capacity=1 << 12)
+        .join()
+    )
+    assert "no torn writes" in unguarded._discoveries_fps
+    guarded = (
+        ShardedKv(2, 2, 1, guarded=True)
+        .checker()
+        .target_state_count(3_000)
+        .spawn_swarm(seed=5, wave_steps=32, aot_cache="t-collide",
+                     lanes=64, sample_capacity=1 << 12)
+        .join()
+    )
+    assert "no torn writes" not in guarded._discoveries_fps
+    assert "no total tear" not in guarded._discoveries_fps
+
+
+def test_swarm_metric_family_hygiene():
+    # The swarm.* family (engine counters + per-tenant view counters +
+    # the shared trace_overflow name) must export to distinct,
+    # grammar-legal Prometheus names — the PR 8 lint, extended to the
+    # new family.
+    from stateright_tpu.telemetry.metrics import MetricsRegistry
+    from stateright_tpu.telemetry.server import registry_hygiene_problems
+
+    reg = MetricsRegistry()
+    for name in (
+        "swarm.wave_calls", "swarm.walk_steps", "swarm.walks_completed",
+        "swarm.restarts", "swarm.restarts_deduped",
+        "swarm.trace_overflow", "swarm.unique_sample",
+    ):
+        reg.counter(name)
+    reg.gauge("swarm.sample_saturated")
+    reg.gauge("swarm.sample_occupancy")
+    reg.histogram("swarm.hit_depth")
+    assert registry_hygiene_problems(reg) == []
+
+
+# -- the sharded-KV zoo model ------------------------------------------------
+
+
+def test_sharded_kv_host_device_parity_guarded():
+    # Guarded: the always-property holds, so both engines explore the
+    # full space — counts and discoveries must match exactly.
+    host = ShardedKv(2, 2, 1, guarded=True).checker().spawn_bfs().join()
+    dev = (
+        ShardedKv(2, 2, 1, guarded=True)
+        .checker()
+        .spawn_tpu_bfs(frontier_capacity=1 << 8, table_capacity=1 << 12)
+        .join()
+    )
+    assert host.unique_state_count() == dev.unique_state_count()
+    assert sorted(host.discoveries()) == sorted(dev.discoveries()) == [
+        "fully migrated", "saturated writes",
+    ]
+    assert "no torn writes" not in host.discoveries()
+
+
+def test_sharded_kv_vacuity_clean_coverage():
+    ck = (
+        ShardedKv(2, 2, 1, guarded=True)
+        .checker()
+        .spawn_tpu_bfs(
+            frontier_capacity=1 << 8, table_capacity=1 << 12,
+            coverage=True,
+        )
+        .join()
+    )
+    rep = ck.coverage_report()
+    vac = rep["vacuity"]
+    assert vac["dead_actions"] == []
+    assert vac["unexercised_always"] == []
+    assert vac["undiscovered_sometimes"] == []
+
+
+def test_sharded_kv_in_zoo():
+    from stateright_tpu.service.zoo import default_zoo
+
+    model = default_zoo()["sharded_kv"](shards=2, keys=2, max_version=1)
+    assert model.packed_action_count() == 2 * (2 + 2)
+
+
+def test_sharded_kv_retain_filters_consistently():
+    m = ShardedKv(2, 2, 1, retain=("no total tear",))
+    assert [p.name for p in m.properties()] == ["no total tear"]
+    assert len(m.packed_conditions()) == 1
+    assert len(m.packed_antecedents()) == 1
+    with pytest.raises(ValueError):
+        ShardedKv(2, 2, 1, retain=("no such property",)).properties()
+    # The deep violation is reachable in the small config too, and the
+    # retained model's run ends exactly at that discovery.
+    ck = (
+        m.checker()
+        .target_state_count(200_000)
+        .spawn_swarm(seed=5, wave_steps=32, **SWARM_KW)
+        .join()
+    )
+    assert ck.worker_error() is None
+    path = ck.discoveries().get("no total tear")
+    assert path is not None and all(path.last_state().torn)

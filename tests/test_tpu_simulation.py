@@ -68,6 +68,47 @@ def test_tpu_simulation_max_depth_cap():
     assert checker.max_depth() <= 4
 
 
+def test_tpu_simulation_trace_overflow_counted_and_reported():
+    # Lanes overflowing the trace buffer with NO user depth cap were
+    # silently aborted like a depth-cap; now they are counted
+    # (swarm.trace_overflow) and the run-end reporter warns, so
+    # truncation is never mistaken for absence of discoveries.
+    import io
+
+    from stateright_tpu.report import WriteReporter
+
+    checker = (
+        TwoPhaseSys(3)
+        .checker()
+        .target_state_count(5_000)
+        .spawn_tpu_simulation(
+            seed=3, lanes=64, steps_per_call=16, max_trace_len=4
+        )
+        .join()
+    )
+    assert checker.worker_error() is None
+    assert checker._trace_overflows > 0
+    assert checker.metrics().snapshot().get("swarm.trace_overflow", 0) > 0
+    out = io.StringIO()
+    checker.report(WriteReporter(out))
+    assert "truncated at the trace buffer" in out.getvalue()
+
+
+def test_tpu_simulation_depth_cap_is_not_overflow():
+    # An explicit target_max_depth IS the buffer bound — a semantic
+    # choice, not truncation: no counter, no warning.
+    checker = (
+        TwoPhaseSys(3)
+        .checker()
+        .target_max_depth(4)
+        .target_state_count(2_000)
+        .spawn_tpu_simulation(seed=5, lanes=64, steps_per_call=16)
+        .join()
+    )
+    assert checker.worker_error() is None
+    assert checker._trace_overflows == 0
+
+
 def test_tpu_simulation_rejects_symmetry():
     with pytest.raises(NotImplementedError):
         TwoPhaseSys(3).checker().symmetry().spawn_tpu_simulation(seed=1)
