@@ -1230,6 +1230,151 @@ def _run_async_ab_leg(pin_cpu: bool):
     print(json.dumps(out))
 
 
+MEGAKERNEL_TIMEOUT_S = 1800
+
+
+def _run_megakernel_leg(pin_cpu: bool):
+    """Child entry: the fused-wave megakernel A/B (BENCH_r16). Two zoo
+    models — 2pc-N (full passing sweep) and the shallow sharded_kv
+    torn-write violation — each run twice with the SAME spawn config:
+    ``wave_kernel="staged"`` (with ``wave_dedup="sort"``, the discipline
+    the fused sweep implements) then ``wave_kernel="fused"``, both with
+    attribution ledgers and ``max_drain_waves=1`` so every wave goes
+    through the per-wave engine and the ledger prices each dispatch.
+    Asserts bit-identical results (counts, depths, golden reporter —
+    including the sharded_kv violation trace) and records per-leg
+    ``utilization``, ``gap_share``, and ``phase_windows`` (the staged
+    chain's ``device`` windows vs the fused path's single
+    ``wave_kernel`` dispatch per wave). On CPU the fused kernel runs
+    under the Pallas interpreter — utilization is advisory there (the
+    interpreter pays a python-loop tax XLA compute doesn't), while the
+    gap_share drop (fewer host/dispatch seams per wave) holds on every
+    backend."""
+    import io
+    import re
+
+    import jax
+
+    if pin_cpu:
+        jax.config.update("jax_platforms", "cpu")
+    from stateright_tpu.utils.compile_cache import enable_persistent_cache
+
+    enable_persistent_cache()
+    from stateright_tpu import WriteReporter
+    from stateright_tpu.models.sharded_kv import ShardedKv
+    from stateright_tpu.models.two_phase_commit import TwoPhaseSys
+    from stateright_tpu.telemetry import metrics_registry
+
+    device = jax.devices()[0]
+    log(f"[megakernel] device: {device.platform} ({device})")
+    rm = int(_parse_float_flag("--mega-rm") or 5)
+    spawn = dict(
+        frontier_capacity=1024,
+        table_capacity=1 << 14,
+        attribution=True,
+        max_drain_waves=1,
+    )
+    primary = f"2pc-{rm}"
+    models = (
+        (primary, lambda: TwoPhaseSys(rm)),
+        (
+            "sharded_kv(2x2 shallow torn-write)",
+            lambda: ShardedKv(2, 2, 1, guarded=False),
+        ),
+    )
+    out = {
+        "device": device.platform,
+        # CPU boxes run the fused kernel under the Pallas interpreter:
+        # wall/utilization are that interpreter's cost, not the
+        # megakernel's — the gap_share drop is the portable claim.
+        "advisory": device.platform == "cpu",
+        "models": {},
+    }
+
+    def golden(checker):
+        sink = io.StringIO()
+        checker.report(WriteReporter(sink))
+        return re.sub(r"sec=\d+", "sec=_", sink.getvalue())
+
+    for mname, make in models:
+        legs = {}
+        goldens = {}
+        for leg, kw in (
+            ("staged", dict(wave_dedup="sort")),
+            ("fused", dict(wave_kernel="fused")),
+        ):
+            metrics_registry().reset()
+            t0 = time.time()
+            checker = (
+                make().checker().spawn_tpu_bfs(**spawn, **kw).join()
+            )
+            wall = time.time() - t0
+            warm = checker.warmup_seconds or 0.0
+            rep = checker.attribution_report()
+            legs[leg] = {
+                "unique": checker.unique_state_count(),
+                "states": checker.state_count(),
+                "max_depth": checker.max_depth(),
+                "wall_s": wall,
+                "warmup_s": warm,
+                "rate": checker.unique_state_count()
+                / max(wall - warm, 1e-9),
+                "utilization": rep.get("utilization"),
+                "gap_share": rep.get("gap_share"),
+                "phase_windows": rep.get("phase_windows"),
+                "attribution": rep,
+            }
+            goldens[leg] = golden(checker)
+            log(
+                f"[megakernel] {mname} {leg}: "
+                f"{legs[leg]['unique']} unique, "
+                f"utilization={(legs[leg]['utilization'] or 0.0):.3f}, "
+                f"gap_share={(legs[leg]['gap_share'] or 0.0):.3f}"
+            )
+        identical = (
+            legs["staged"]["unique"] == legs["fused"]["unique"]
+            and legs["staged"]["states"] == legs["fused"]["states"]
+            and legs["staged"]["max_depth"] == legs["fused"]["max_depth"]
+            and goldens["staged"] == goldens["fused"]
+        )
+        if not identical:
+            raise AssertionError(
+                f"fused leg diverged from staged on {mname}: "
+                f"{ {k: (v['unique'], v['states'], v['max_depth']) for k, v in legs.items()} }"
+            )
+        rec = {
+            "bit_identical": True,
+            "staged": legs["staged"],
+            "fused": legs["fused"],
+            "utilization_delta": (
+                (legs["fused"]["utilization"] or 0.0)
+                - (legs["staged"]["utilization"] or 0.0)
+            ),
+            "gap_share_delta": (
+                (legs["fused"]["gap_share"] or 0.0)
+                - (legs["staged"]["gap_share"] or 0.0)
+            ),
+        }
+        if rec["gap_share_delta"] >= 0:
+            log(
+                f"[megakernel] WARNING: {mname} fused gap_share did not "
+                f"drop ({rec['gap_share_delta']:+.3f})"
+            )
+        if rec["utilization_delta"] <= 0 and not out["advisory"]:
+            log(
+                f"[megakernel] WARNING: {mname} fused utilization did "
+                f"not rise ({rec['utilization_delta']:+.3f})"
+            )
+        out["models"][mname] = rec
+    prim = out["models"][primary]
+    out["bit_identical"] = all(
+        r["bit_identical"] for r in out["models"].values()
+    )
+    out["gap_share_delta"] = prim["gap_share_delta"]
+    out["utilization_delta"] = prim["utilization_delta"]
+    print(json.dumps(out))
+
+
 LIVENESS_TIMEOUT_S = 1200
 
 
@@ -1861,6 +2006,52 @@ def _main_async_ab():
     print(json.dumps(line))
 
 
+def _main_megakernel():
+    """Parent entry for ``bench.py --megakernel``: runs the fused-wave
+    A/B leg in a child (wedge isolation) and prints the one BENCH-record
+    JSON line (BENCH_r16.json; render with ``scripts/bench_compare.py
+    --megakernel``)."""
+    on_accel = _accelerator_usable()
+    passthrough = []
+    value = _parse_float_flag("--mega-rm")
+    if value is not None:
+        passthrough += ["--mega-rm", str(value)]
+
+    def run(pin_cpu):
+        argv = [sys.executable, __file__, "--megakernel-leg", *passthrough]
+        if pin_cpu:
+            argv.append("--cpu")
+        return _child_json(
+            argv, MEGAKERNEL_TIMEOUT_S * (3 if pin_cpu else 1), "megakernel"
+        )
+
+    rec = run(pin_cpu=not on_accel)
+    if rec is None and on_accel:
+        log("[megakernel] falling back to CPU-pinned run")
+        rec = run(pin_cpu=True)
+    if rec is None:
+        print(
+            json.dumps(
+                {
+                    "metric": "fused wave megakernel A/B "
+                    "(2pc + sharded_kv shallow, staged vs fused)",
+                    "value": 0,
+                    "unit": "gap_share delta (fused - staged)",
+                    "error": "megakernel leg failed on every backend",
+                }
+            )
+        )
+        return
+    line = {
+        "metric": "fused wave megakernel A/B "
+        "(2pc + sharded_kv shallow, staged vs fused)",
+        "value": round(rec["gap_share_delta"], 4),
+        "unit": "gap_share delta (fused - staged)",
+        **rec,
+    }
+    print(json.dumps(line))
+
+
 def _main_service(packed: bool = False):
     """Parent entry for ``bench.py --service`` / ``--service-packed``:
     runs the service leg in a child (wedge isolation, like every other
@@ -1924,6 +2115,10 @@ def main():
         return _run_async_ab_leg("--cpu" in sys.argv)
     if "--async-ab" in sys.argv:
         return _main_async_ab()
+    if "--megakernel-leg" in sys.argv:
+        return _run_megakernel_leg("--cpu" in sys.argv)
+    if "--megakernel" in sys.argv:
+        return _main_megakernel()
     if "--liveness-leg" in sys.argv:
         return _run_liveness_leg("--cpu" in sys.argv)
     if "--liveness" in sys.argv:

@@ -373,6 +373,87 @@ def ab_async_report(path, out=sys.stdout):
     return 0
 
 
+def megakernel_report(path, out=sys.stdout):
+    """The fused-wave megakernel A/B table from one ``bench.py
+    --megakernel`` record (BENCH_r16): per-model staged-vs-fused
+    utilization, gap share, dispatch windows per wave (the staged
+    chain's ``device`` windows vs the fused path's single
+    ``wave_kernel`` dispatch), and rate. Always advisory (exit 0 when
+    the record parsed): on CPU the fused kernel runs under the Pallas
+    interpreter, so wall/utilization are the interpreter's cost — the
+    bit-identical assert lives in the bench child itself."""
+    with open(path) as f:
+        obj = json.load(f)
+    rec = obj.get("parsed") if isinstance(obj, dict) and "parsed" in obj \
+        else obj
+    if not isinstance(rec, dict) or "models" not in rec:
+        print(
+            f"error: {path}: no megakernel A/B record (produce one with "
+            "bench.py --megakernel)",
+            file=sys.stderr,
+        )
+        return 2
+    out.write(
+        f"fused wave megakernel A/B ({rec.get('device')}"
+        + (", advisory" if rec.get("advisory") else "")
+        + ")\n"
+    )
+
+    def pct(v):
+        return f"{100.0 * v:.1f}%" if v is not None else "-"
+
+    def windows(leg):
+        w = leg.get("phase_windows") or {}
+        n = w.get("wave_kernel", w.get("device"))
+        waves = (leg.get("attribution") or {}).get("waves")
+        if n is None or not waves:
+            return "-"
+        return f"{n}/{waves}w"
+
+    for mname, m in rec["models"].items():
+        staged, fused = m.get("staged") or {}, m.get("fused") or {}
+        out.write(f"\n{mname}\n")
+        header = (
+            f"{'':<14} {'staged':>12} {'fused':>12} {'delta':>9}"
+        )
+        out.write(header + "\n" + "-" * len(header) + "\n")
+        u_s, u_f = staged.get("utilization"), fused.get("utilization")
+        u_delta = (
+            f"{100.0 * (u_f - u_s):+.1f}pp"
+            if u_s is not None and u_f is not None
+            else ""
+        )
+        out.write(
+            f"{'utilization':<14} {pct(u_s):>12} {pct(u_f):>12} "
+            f"{u_delta:>9}\n"
+        )
+        g_s, g_f = staged.get("gap_share"), fused.get("gap_share")
+        g_delta = (
+            f"{100.0 * (g_f - g_s):+.1f}pp"
+            if g_s is not None and g_f is not None
+            else ""
+        )
+        out.write(
+            f"{'gap share':<14} {pct(g_s):>12} {pct(g_f):>12} "
+            f"{g_delta:>9}\n"
+        )
+        out.write(
+            f"{'dispatches':<14} {windows(staged):>12} "
+            f"{windows(fused):>12}\n"
+        )
+        r_s, r_f = staged.get("rate"), fused.get("rate")
+        rate_delta = (
+            f"{(r_f - r_s) / r_s:+.1%}" if r_s and r_f else ""
+        )
+        out.write(
+            f"{'states/s':<14} {_fmt(r_s):>12} {_fmt(r_f):>12} "
+            f"{rate_delta:>9}\n"
+        )
+        if m.get("bit_identical") is not None:
+            out.write(f"bit-identical: {m['bit_identical']}\n")
+    return 0
+
+
 def swarm_report(path, out=sys.stdout):
     """The swarm-verification table from one ``bench.py --swarm``
     record (BENCH_r15): per-leg time-to-first-violation (swarm vs
@@ -494,6 +575,12 @@ def main(argv=None):
         "realized utilization) from one bench.py --async-ab record",
     )
     parser.add_argument(
+        "--megakernel", action="store_true",
+        help="render the fused-wave megakernel A/B table (per-model "
+        "staged vs fused utilization, gap share, dispatch windows) from "
+        "one bench.py --megakernel record",
+    )
+    parser.add_argument(
         "--swarm", action="store_true",
         help="render the swarm-verification table (ttfv vs exhaustive, "
         "walk throughput, coverage sample) from one bench.py --swarm "
@@ -510,6 +597,19 @@ def main(argv=None):
 
     if args.service_trajectory:
         return service_trajectory(args.files)
+
+    if args.megakernel:
+        if len(args.files) != 1:
+            print(
+                "error: --megakernel takes exactly one bench record",
+                file=sys.stderr,
+            )
+            return 2
+        try:
+            return megakernel_report(args.files[0])
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"error: {args.files[0]}: {e}", file=sys.stderr)
+            return 2
 
     if args.swarm:
         if len(args.files) != 1:

@@ -44,6 +44,7 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 from trace_summary import (  # noqa: E402
+    DEVICE_PHASES,
     HOST_OVERLAPPABLE,
     PHASE_ORDER,
     attribution_rows,
@@ -90,7 +91,7 @@ def overlap_headroom(led):
     phases => zero headroom, predicted == measured)."""
     phases = led["phases_ms"]
     wall = led["wall_ms"]
-    device = phases.get("device", 0.0)
+    device = sum(phases.get(p, 0.0) for p in DEVICE_PHASES)
     host = sum(phases.get(p, 0.0) for p in HOST_OVERLAPPABLE)
     headroom = min(host, device)
     return {

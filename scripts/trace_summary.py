@@ -39,10 +39,13 @@ import sys
 # HOST_OVERLAPPABLE_PHASES — the scripts cannot import the package
 # because traces must stay inspectable on boxes without jax.
 PHASE_ORDER = (
-    "device", "host_probe", "evict", "table_grow", "checkpoint",
-    "compile", "gap",
+    "device", "wave_kernel", "host_probe", "evict", "table_grow",
+    "checkpoint", "compile", "gap",
 )
 HOST_OVERLAPPABLE = ("host_probe", "evict", "checkpoint")
+# Device-compute phase class: the staged wave chain ("device") and the
+# fused Pallas megakernel's single dispatch ("wave_kernel").
+DEVICE_PHASES = ("device", "wave_kernel")
 
 
 def load_events(path):

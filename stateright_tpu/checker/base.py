@@ -579,6 +579,13 @@ class Checker(Generic[State, Action]):
             for name, path in self.discoveries().items()
         }
         reporter.report_discoveries(discoveries)
+        # Silent-adjustment honesty: configuration the checker rounded or
+        # rewrote on the user's behalf (e.g. tile-aligned table capacity
+        # for the tile-sweep kernels) is reported on every run — even an
+        # early exit ran with the adjusted values.
+        notes = getattr(self, "config_notes", None)
+        if notes:
+            reporter.report_config_notes(notes)
         # Run-end vacuity visibility (upstream-parity, see MIGRATING.md):
         # a sometimes/eventually property with no discovery is a silent
         # pass unless the reporter says so — even without the coverage

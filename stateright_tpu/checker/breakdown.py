@@ -115,6 +115,7 @@ def measure_wave_breakdown(
     iters: int = 20,
     wave_dedup: str | None = None,
     bucket_ladder: int | None = None,
+    wave_kernel: str = "staged",
 ) -> Dict:
     """Stage-split timings + cost analysis on a representative wave.
 
@@ -136,6 +137,16 @@ def measure_wave_breakdown(
     default the checker uses (``default_wave_dedup``). ``bucket_ladder``
     mirrors the checker knob (None = the default ladder, 0 = fixed
     width).
+
+    ``wave_kernel="fused"`` attributes the Pallas wave megakernel
+    (``ops/pallas_wave.py``) instead of the staged stage split: the
+    whole wave is ONE dispatch, so ``stages_ms`` holds a single
+    ``wave_kernel`` entry and ``dispatches_per_wave`` drops to 1 (the
+    staged split reports its stage count there — the dispatch-overhead
+    collapse the megakernel buys, rendered by ``bench.py
+    --megakernel``). The fused kernel fixes the sorted-dedup
+    discipline, so ``wave_dedup="scatter"`` is rejected, and
+    ``table_capacity`` is tile-rounded like the checker does.
     """
     from .tpu import (
         _AUTO_BUCKET_MIN_F,
@@ -145,6 +156,20 @@ def measure_wave_breakdown(
         default_wave_dedup,
     )
 
+    if wave_kernel not in ("staged", "fused"):
+        raise ValueError(
+            f"wave_kernel must be 'staged' or 'fused': {wave_kernel!r}"
+        )
+    if wave_kernel == "fused":
+        if wave_dedup == "scatter":
+            raise ValueError(
+                "wave_kernel='fused' fixes the sorted-dedup discipline; "
+                "attribute wave_dedup='scatter' with wave_kernel='staged'"
+            )
+        wave_dedup = "sort"
+        from ..ops.pallas_hashset import round_table_capacity
+
+        table_capacity = round_table_capacity(table_capacity)
     if wave_dedup is None:
         wave_dedup = default_wave_dedup(jax.default_backend())
     if wave_dedup not in ("sort", "scatter"):
@@ -169,6 +194,10 @@ def measure_wave_breakdown(
         type(model).packed_expand_fps is not BatchableModel.packed_expand_fps
         and type(model).packed_take is not BatchableModel.packed_take
     )
+    if wave_kernel == "fused":
+        # The fused megakernel materializes the candidate grid in VMEM
+        # scratch — the checker refuses expand_fps under it; mirror.
+        use_fps = False
 
     def expand(states, mask):
         cand, cvalid = jax.vmap(model.packed_expand)(states)
@@ -391,6 +420,55 @@ def measure_wave_breakdown(
             stages["sort_dedup"] = (j_sort, (chi, clo, cvalid))
             stages["insert"] = (j_insert, (table, shi, slo, active))
             stages["compact"] = (j_compact, (cand, sidx, active))
+    staged_dispatches = len(stages)
+    if wave_kernel == "fused":
+        # The whole wave is ONE Pallas dispatch: replace the stage table
+        # with the single wave_kernel stage the checker actually runs.
+        from ..ops.pallas_wave import FusedWaveSpec, fused_wave
+
+        props_list = list(model.properties())
+        if len(conditions) != len(props_list):
+            raise ValueError(
+                "packed_conditions() must align 1:1 with properties(): "
+                f"{len(conditions)} != {len(props_list)}"
+            )
+        eventually = [
+            i
+            for i, p in enumerate(props_list)
+            if getattr(p.expectation, "value", None) == "eventually"
+        ]
+        ebit = tuple((pi, b) for b, pi in enumerate(eventually))
+        spec = FusedWaveSpec(
+            expand=model.packed_expand,
+            within_boundary=model.packed_within_boundary,
+            fp_fn=fp_fn,
+            conditions=tuple(conditions),
+            expectations=tuple(
+                p.expectation.value for p in props_list
+            ),
+            ebit=ebit,
+            action_count=A,
+            interpret=jax.default_backend() != "tpu",
+        )
+        hi_w, lo_w = jax.vmap(fp_fn)(states_w)
+        ebits_w = jnp.full(
+            (bucket,), sum(1 << b for _pi, b in ebit), jnp.uint32
+        )
+        depth_w = jnp.zeros((bucket,), jnp.int32)
+
+        def mega(table, states, hi, lo, ebits, depth, mask):
+            return fused_wave(
+                spec, table, states, hi, lo, ebits, depth, mask,
+                jnp.int32(2**31 - 1),
+            )
+
+        j_mega = jax.jit(mega)
+        stages = {
+            "wave_kernel": (
+                j_mega,
+                (table, states_w, hi_w, lo_w, ebits_w, depth_w, mask_w),
+            )
+        }
     out = {
         "frontier_capacity": F,
         "action_count": A,
@@ -402,6 +480,14 @@ def measure_wave_breakdown(
         "device": jax.devices()[0].platform,
         "device_kind": jax.devices()[0].device_kind,
         "wave_dedup": wave_dedup,
+        "wave_kernel": wave_kernel,
+        # Kernel launches one wave pays: the staged split's stage count
+        # vs the megakernel's single dispatch — the overhead collapse
+        # bench.py --megakernel renders.
+        "dispatches_per_wave": (
+            1 if wave_kernel == "fused" else staged_dispatches
+        ),
+        "table_capacity": table_capacity,
         "stages_ms": {},
         "stage_cost": {},
     }

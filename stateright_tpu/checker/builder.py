@@ -122,7 +122,13 @@ class CheckerBuilder:
     def spawn_tpu_bfs(self, **kwargs):
         """TPU-accelerated BFS: vmapped frontier expansion + device-resident
         fingerprint set. Requires the model to implement ``BatchableModel``
-        (or be convertible via ``stateright_tpu.models.packing``)."""
+        (or be convertible via ``stateright_tpu.models.packing``).
+        ``wave_kernel="fused"`` runs the whole wave body — expand,
+        fingerprint, sort-dedup, the VMEM tile-sweep insert, compaction,
+        properties, coverage — as one Pallas dispatch per wave instead
+        of the staged XLA chain (README "Fused wave megakernel");
+        bit-identical to ``wave_kernel="staged"`` with
+        ``wave_dedup="sort"``, interpreted off-TPU."""
         from .tpu import TpuBfsChecker
 
         return TpuBfsChecker(self, **kwargs)

@@ -15,6 +15,13 @@ machinery sorts/compares lexicographically on (hi, lo).
 
 The all-zero pair is reserved as the hash-set empty sentinel; fingerprints
 are nudged to (0, 1) if they collide with it.
+
+Kernel note: these functions are pure jnp word-mixing (no gather/scatter,
+no host callbacks), so they trace cleanly *inside* Pallas kernels — the
+fused wave megakernel (``ops/pallas_wave.py``) runs ``fingerprint_state``
+over the candidate grid in its closure-converted prologue, and any model
+``packed_fingerprint`` override must keep the same property to stay
+fusable.
 """
 
 from __future__ import annotations

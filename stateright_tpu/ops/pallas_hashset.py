@@ -40,12 +40,59 @@ import jax.numpy as jnp
 
 from .hashset import MAX_PROBES
 
-__all__ = ["pallas_hashset_insert", "TILE_ROWS"]
+__all__ = [
+    "pallas_hashset_insert",
+    "probe_claim",
+    "round_table_capacity",
+    "TILE_ROWS",
+]
 
 # Table rows per grid step. 2048 rows × (2×4B) = 16KB window DMA (+ apron).
 TILE_ROWS = 2048
 # Keys resolved per inner chunk (bounds the per-chunk VMEM staging).
 _KC = 256
+
+
+def round_table_capacity(capacity: int) -> int:
+    """The smallest power-of-two multiple of ``TILE_ROWS`` that holds
+    ``capacity`` rows — the admissible table size for the tile-sweep
+    kernels (``pallas_hashset_insert`` and the fused wave megakernel,
+    ``ops/pallas_wave.py``), which grid over ``TILE_ROWS``-row table
+    tiles. ``TILE_ROWS`` is itself a power of two, so every power of two
+    at or above it is tile-aligned; callers report the adjustment
+    instead of refusing admission."""
+    c = max(int(capacity), TILE_ROWS)
+    return 1 << (c - 1).bit_length()
+
+
+def probe_claim(window, kh, kl, local):
+    """Resolve one key against a VMEM table window: compare its
+    ``MAX_PROBES``-row probe window at ``local``, claim the first empty
+    slot when no match precedes it, and return ``(can_claim,
+    is_found)``. Sequential per-key use makes the claim race-free — the
+    next key observes this write in VMEM immediately, which is exact
+    CAS-free open addressing. The claim is a masked whole-probe-window
+    rewrite (a vector store — Mosaic handles dynamic scalar stores to
+    VMEM poorly). Shared by the insert kernel below and the fused wave
+    megakernel (``ops/pallas_wave.py``)."""
+    from jax.experimental import pallas as pl
+
+    rows_hi = window[pl.ds(local, MAX_PROBES), 0]
+    rows_lo = window[pl.ds(local, MAX_PROBES), 1]
+    idx = jax.lax.broadcasted_iota(
+        jnp.int32, (MAX_PROBES, 1), 0
+    ).reshape(MAX_PROBES)
+    big = jnp.int32(MAX_PROBES)
+    empty = (rows_hi == 0) & (rows_lo == 0)
+    match = (rows_hi == kh) & (rows_lo == kl)
+    first_empty = jnp.min(jnp.where(empty, idx, big))
+    first_match = jnp.min(jnp.where(match, idx, big))
+    is_found = first_match < first_empty
+    can_claim = (first_empty < big) & ~is_found
+    claim = can_claim & (idx == first_empty)
+    window[pl.ds(local, MAX_PROBES), 0] = jnp.where(claim, kh, rows_hi)
+    window[pl.ds(local, MAX_PROBES), 1] = jnp.where(claim, kl, rows_lo)
+    return can_claim, is_found
 
 
 def _insert_kernel(
@@ -104,30 +151,7 @@ def _insert_kernel(
                         (kh >> shift.astype(jnp.uint32)).astype(jnp.int32)
                         - base
                     )
-                    rows_hi = window[pl.ds(local, MAX_PROBES), 0]
-                    rows_lo = window[pl.ds(local, MAX_PROBES), 1]
-                    idx = jax.lax.broadcasted_iota(
-                        jnp.int32, (MAX_PROBES, 1), 0
-                    ).reshape(MAX_PROBES)
-                    big = jnp.int32(MAX_PROBES)
-                    empty = (rows_hi == 0) & (rows_lo == 0)
-                    match = (rows_hi == kh) & (rows_lo == kl)
-                    first_empty = jnp.min(jnp.where(empty, idx, big))
-                    first_match = jnp.min(jnp.where(match, idx, big))
-                    is_found = first_match < first_empty
-                    can_claim = (first_empty < big) & ~is_found
-                    # Sequential processing makes the claim race-free: the
-                    # next key observes this write in VMEM immediately. The
-                    # claim is a masked whole-probe-window rewrite (a
-                    # vector store — Mosaic handles dynamic scalar stores
-                    # to VMEM poorly).
-                    claim = can_claim & (idx == first_empty)
-                    window[pl.ds(local, MAX_PROBES), 0] = jnp.where(
-                        claim, kh, rows_hi
-                    )
-                    window[pl.ds(local, MAX_PROBES), 1] = jnp.where(
-                        claim, kl, rows_lo
-                    )
+                    can_claim, is_found = probe_claim(window, kh, kl, local)
                     fresh_ref[i] = can_claim.astype(jnp.uint32)
                     found_ref[i] = is_found.astype(jnp.uint32)
                     pending_ref[i] = (~is_found & ~can_claim).astype(

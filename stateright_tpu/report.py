@@ -56,6 +56,14 @@ class Reporter:
         never be mistaken for absence of discoveries. Default no-op
         keeps existing reporters source-compatible."""
 
+    def report_config_notes(self, notes) -> None:
+        """Called once per report with backend configuration adjustments
+        the checker made silently on the user's behalf (e.g. the
+        tile-sweep kernels rounding ``table_capacity`` up to a
+        tile-aligned power of two) — an adjusted run must never read as
+        the run that was asked for. Default no-op keeps existing
+        reporters source-compatible."""
+
     def delay(self) -> float:
         """Seconds between progress reports."""
         return 1.0
@@ -117,6 +125,10 @@ class WriteReporter(Reporter):
             "those walks is NOT evidence\n"
         )
 
+    def report_config_notes(self, notes) -> None:
+        for note in notes:
+            self.writer.write(f"Note: {note}\n")
+
 
 class TelemetryReporter(Reporter):
     """Renders telemetry metrics snapshots alongside (not instead of) an
@@ -171,6 +183,10 @@ class TelemetryReporter(Reporter):
     def report_truncation(self, overflows: int) -> None:
         if self.inner is not None:
             self.inner.report_truncation(overflows)
+
+    def report_config_notes(self, notes) -> None:
+        if self.inner is not None:
+            self.inner.report_config_notes(notes)
 
     def delay(self) -> float:
         return self.inner.delay() if self.inner is not None else 1.0

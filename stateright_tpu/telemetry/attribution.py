@@ -84,6 +84,7 @@ from .metrics import MetricsRegistry, metrics_registry
 from .trace import Tracer, get_tracer
 
 __all__ = [
+    "DEVICE_PHASES",
     "HOST_OVERLAPPABLE_PHASES",
     "PHASES",
     "WaveAttribution",
@@ -96,6 +97,7 @@ __all__ = [
 # readers must stay importable without this package (no-jax boxes).
 PHASES = (
     "device",
+    "wave_kernel",
     "host_probe",
     "evict",
     "table_grow",
@@ -107,6 +109,12 @@ PHASES = (
 # table_grow/compile are device-serial (the next wave needs their
 # output), so they are NOT overlappable.
 HOST_OVERLAPPABLE_PHASES = ("host_probe", "evict", "checkpoint")
+# Phases that ARE device compute: "device" is the staged wave chain,
+# "wave_kernel" the fused Pallas megakernel's single dispatch
+# (wave_kernel="fused" — ops/pallas_wave.py). Utilization and the
+# overlap-headroom denominator sum the class, so the two wave engines
+# report comparable ledgers.
+DEVICE_PHASES = ("device", "wave_kernel")
 DEFAULT_TOLERANCE = 0.05
 
 
@@ -218,7 +226,10 @@ class _Wave:
         attr._c_wall.inc(wall)
         attr._c_gap.inc(gap)
         if attr._wall_s > 0:
-            attr._g_util.set(attr._totals.get("device", 0.0) / attr._wall_s)
+            device = sum(
+                attr._totals.get(p, 0.0) for p in DEVICE_PHASES
+            )
+            attr._g_util.set(device / attr._wall_s)
             attr._g_gap.set(attr._gap_s / attr._wall_s)
         self._span.set(
             wall_ms=wall * 1e3,
@@ -252,6 +263,11 @@ class WaveAttribution:
         self._registry = reg
         self.tolerance = tolerance
         self._totals: Dict[str, float] = {}
+        # Window counts per phase: the fused wave's dispatch-overhead
+        # story needs *how many* kernel dispatches a wave paid, not just
+        # their seconds (one "wave_kernel" window per fused dispatch vs
+        # the staged chain's per-stage XLA executables).
+        self._windows: Dict[str, int] = {}
         # Phase time accrued OUTSIDE any wave window (seed/restore-time
         # checkpoint reads, the restore path's table grows): reported
         # separately so the in-wave phases + gap still sum to the wave
@@ -330,6 +346,7 @@ class WaveAttribution:
         if cur is not None:
             cur.phases[name] = cur.phases.get(name, 0.0) + dt
             self._totals[name] = self._totals.get(name, 0.0) + dt
+            self._windows[name] = self._windows.get(name, 0) + 1
         else:
             self._outside[name] = self._outside.get(name, 0.0) + dt
         c = self._phase_counters.get(name)
@@ -435,7 +452,7 @@ class WaveAttribution:
         self._profile_finalize()
         wall = self._wall_s
         phases = {k: v for k, v in sorted(self._totals.items())}
-        device = phases.get("device", 0.0)
+        device = sum(phases.get(p, 0.0) for p in DEVICE_PHASES)
         host = sum(phases.get(p, 0.0) for p in HOST_OVERLAPPABLE_PHASES)
         headroom = min(host, device)
         with self._ov_lock:
@@ -455,6 +472,9 @@ class WaveAttribution:
             "phase_share": (
                 {k: v / wall for k, v in phases.items()} if wall else {}
             ),
+            "phase_windows": {
+                k: v for k, v in sorted(self._windows.items())
+            },
             "gap_share": (self._gap_s / wall) if wall else None,
             "utilization": (device / wall) if wall else None,
             "overlap_headroom": {

@@ -1,0 +1,259 @@
+"""Fused Pallas wave megakernel (``wave_kernel="fused"``): staged-vs-fused
+bit-identity across the zoo, composition with preempt/resume and the
+capability surfaces, and honest refusals.
+
+The fused wave (ops/pallas_wave.py) runs the whole wave body — packed
+expand, fingerprinting, sort-dedup, the VMEM tile-sweep insert,
+compaction, property evaluation, coverage reductions — in ONE Pallas
+dispatch. Off-TPU it executes under the Pallas interpreter with exact
+semantics, so this module exercises the real kernel logic on CPU: every
+check here compares against ``wave_kernel="staged"`` with
+``wave_dedup="sort"`` — the dedup discipline the fused sweep embeds —
+and demands BIT-IDENTICAL results (counts, depths, discovery
+fingerprints, golden reports including violation traces, coverage
+ledgers).
+
+Interpret-mode waves are slow, so the 2pc-3 pair is spawned ONCE as
+module fixtures (with coverage recording on, so the same pair also
+settles the coverage-ledger identity) and shared by every 2pc-shaped
+assertion; only checks whose config genuinely differs (per-wave engine,
+preempt/resume, capacity rounding) pay their own spawns."""
+
+import io
+import re
+import time
+
+import pytest
+
+from stateright_tpu import WriteReporter
+from stateright_tpu.models.sharded_kv import ShardedKv
+from stateright_tpu.models.two_phase_commit import TwoPhaseSys
+from test_tpu_bfs import Chain
+
+# Shared shapes: 4096 rows = 2 tile-sweep tiles, so the fused grid's
+# window chaining (apron patching across consecutive tiles) is
+# exercised, not just the single-tile fast case.
+SPAWN = {"frontier_capacity": 256, "table_capacity": 1 << 12}
+
+
+def _golden(checker):
+    out = io.StringIO()
+    checker.report(WriteReporter(out))
+    return re.sub(r"sec=\d+", "sec=_", out.getvalue())
+
+
+def _spawn(model, **kw):
+    checker = model.checker().spawn_tpu_bfs(**SPAWN, **kw).join()
+    assert checker.worker_error() is None
+    return checker
+
+
+def _assert_bit_identical(fused, staged):
+    assert fused.unique_state_count() == staged.unique_state_count()
+    assert fused.state_count() == staged.state_count()
+    assert fused.max_depth() == staged.max_depth()
+    assert fused._discoveries_fp == staged._discoveries_fp
+    assert _golden(fused) == _golden(staged)
+
+
+@pytest.fixture(scope="module")
+def staged_2pc():
+    return _spawn(TwoPhaseSys(3), wave_dedup="sort", coverage=True)
+
+
+@pytest.fixture(scope="module")
+def fused_2pc():
+    return _spawn(TwoPhaseSys(3), wave_kernel="fused", coverage=True)
+
+
+# -- zoo bit-identity -------------------------------------------------------
+
+ZOO = [
+    # Shallow always-violation at depth 2: the golden compare pins the
+    # first-violation trace, not just the verdict.
+    ("sharded_kv unguarded", lambda: ShardedKv(2, 2, 1, guarded=False)),
+    # The fixed protocol: same shapes, passing verdict.
+    ("sharded_kv guarded", lambda: ShardedKv(2, 2, 1, guarded=True)),
+    # Eventually counterexample (unreachable target -> terminal trace).
+    ("chain eventually-violation", lambda: Chain(6, reach=9)),
+    # Eventually discharged at the terminal.
+    ("chain eventually-pass", lambda: Chain(6, reach=6)),
+]
+
+
+@pytest.mark.parametrize(
+    "make", [m for _, m in ZOO], ids=[n for n, _ in ZOO]
+)
+def test_zoo_fused_bit_identical_to_staged(make):
+    staged = _spawn(make(), wave_dedup="sort")
+    fused = _spawn(make(), wave_kernel="fused")
+    _assert_bit_identical(fused, staged)
+
+
+def test_2pc_fused_bit_identical_to_staged(fused_2pc, staged_2pc):
+    # Full passing sweep with always + sometimes + eventually properties
+    # against the reference counts.
+    _assert_bit_identical(fused_2pc, staged_2pc)
+    assert fused_2pc.unique_state_count() == 288
+    assert fused_2pc.state_count() == 1146
+    assert fused_2pc.max_depth() == 11
+    fused_2pc.assert_properties()
+
+
+def test_fused_coverage_ledger_bit_identical(fused_2pc, staged_2pc):
+    cov_s, cov_f = staged_2pc.coverage_report(), fused_2pc.coverage_report()
+    assert cov_s is not None and cov_f is not None
+    assert cov_f == cov_s
+
+
+def test_fused_per_wave_path_matches_deep_drain(fused_2pc):
+    # max_drain_waves=1 forces the per-wave host loop (the path bench
+    # attribution prices); the fixture ran the deep device drain. Both
+    # must agree (the coverage ledger rides the golden report).
+    wave = _spawn(
+        TwoPhaseSys(3), wave_kernel="fused", coverage=True,
+        max_drain_waves=1,
+    )
+    _assert_bit_identical(wave, fused_2pc)
+
+
+# -- preempt/resume composition ---------------------------------------------
+
+
+def test_fused_preempt_resume_bit_identical():
+    """A fused run suspended mid-space and resumed (still fused) must
+    match the uninterrupted fused run exactly — the checkpoint payload
+    carries no engine-specific state, so the megakernel composes with
+    the service's suspend machinery rather than refusing it."""
+    spawn = dict(wave_kernel="fused", aot_cache="t-fused-preempt")
+    reference = _spawn(TwoPhaseSys(3), **spawn)
+    assert reference.unique_state_count() == 288
+
+    first = TwoPhaseSys(3).checker().spawn_tpu_bfs(
+        max_drain_waves=2, **SPAWN, **spawn
+    )
+    deadline = time.monotonic() + 120.0
+    while (
+        first.unique_state_count() < 80
+        and not first.is_done()
+        and time.monotonic() < deadline
+    ):
+        time.sleep(0.002)
+    first.request_preempt()
+    for h in first.handles():
+        h.join()
+    assert first.worker_error() is None
+    if not first.preempted:
+        pytest.skip("run finished before the preempt request landed")
+    assert first.unique_state_count() < 288
+
+    resumed = (
+        TwoPhaseSys(3)
+        .checker()
+        .spawn_tpu_bfs(resume_from=first.preempt_payload(), **SPAWN, **spawn)
+        .join()
+    )
+    assert resumed.worker_error() is None
+    _assert_bit_identical(resumed, reference)
+
+
+# -- capacity ergonomics ----------------------------------------------------
+
+
+def test_fused_rounds_table_capacity_with_note():
+    # 3000 rows is not a tile-sweep shape; admission rounds up to the
+    # next power of two >= TILE_ROWS and SAYS so (config_notes reach the
+    # report via Reporter.report_config_notes). The staged XLA path
+    # would refuse 3000 outright (power-of-two assert in the worker).
+    checker = (
+        Chain(6)
+        .checker()
+        .spawn_tpu_bfs(
+            frontier_capacity=64, table_capacity=3000,
+            wave_kernel="fused",
+        )
+        .join()
+    )
+    assert checker.worker_error() is None
+    assert checker.config_notes
+    assert any("rounded 3000 -> 4096" in n for n in checker.config_notes)
+    assert "Note: table_capacity rounded 3000 -> 4096" in _golden(checker)
+    assert checker.unique_state_count() == 7
+
+
+def test_staged_valid_capacity_reports_no_note(staged_2pc):
+    # The note fires only when admission actually adjusted something: a
+    # staged run with an admissible capacity reports none.
+    assert not staged_2pc.config_notes
+    assert "Note:" not in _golden(staged_2pc)
+
+
+# -- honest refusals + capability surfaces ----------------------------------
+
+
+def test_fused_refuses_scatter_dedup():
+    with pytest.raises(ValueError, match="scatter.*incompatible"):
+        TwoPhaseSys(3).checker().spawn_tpu_bfs(
+            **SPAWN, wave_kernel="fused", wave_dedup="scatter"
+        )
+
+
+def test_fused_refuses_symmetry():
+    with pytest.raises(ValueError, match="symmetry"):
+        TwoPhaseSys(3).checker().symmetry().spawn_tpu_bfs(
+            **SPAWN, wave_kernel="fused"
+        )
+
+
+def test_fused_refuses_expand_fps():
+    with pytest.raises(ValueError, match="expand_fps"):
+        TwoPhaseSys(3).checker().spawn_tpu_bfs(
+            **SPAWN, wave_kernel="fused", expand_fps=True
+        )
+
+
+def test_fused_refuses_device_liveness():
+    with pytest.raises(ValueError, match="liveness='device'"):
+        TwoPhaseSys(3).checker().spawn_tpu_bfs(
+            **SPAWN, wave_kernel="fused", liveness="device"
+        )
+
+
+def test_invalid_wave_kernel_rejected():
+    with pytest.raises(ValueError, match="wave_kernel"):
+        TwoPhaseSys(3).checker().spawn_tpu_bfs(
+            **SPAWN, wave_kernel="mega"
+        )
+
+
+def test_fused_declares_itself_unpackable(fused_2pc, staged_2pc):
+    # The tenant-packed engine dispatches the staged wave only; a fused
+    # job must say it runs solo (the PR 12 packable_reason convention)
+    # instead of silently falling back.
+    assert fused_2pc.packing_reason
+    assert "fused" in fused_2pc.packing_reason
+    assert staged_2pc.packing_reason is None
+
+
+def test_service_classifies_fused_spawn_as_unpackable():
+    # The service's admission classifier already rejects any spawn
+    # override from packing; wave_kernel='fused' therefore time-slices
+    # solo with an honest reason — never a silent downgrade to staged.
+    from stateright_tpu.service.service import CheckService
+
+    svc = CheckService.__new__(CheckService)
+    svc.packing = True
+    svc.spawn_method = "spawn_tpu_bfs"
+    svc.default_spawn = {}
+    packable, reason = svc._classify_packable(
+        aot_namespace="2pc",
+        options={},
+        spawn={"wave_kernel": "fused"},
+        hbm_budget_mib=None,
+    )
+    assert packable is False
+    assert "wave_kernel" in reason
+
+
+def test_fused_state_digest_records_engine(fused_2pc):
+    assert fused_2pc.state_digest()["wave_kernel"] == "fused"
