@@ -547,6 +547,114 @@ def swarm_report(path, out=sys.stdout):
     return 0
 
 
+def multichip_trajectory(paths, out=sys.stdout):
+    """The pod-scale sharding trajectory across ``MULTICHIP_r*.json``
+    records (r01 dryruns -> r06 sieve A/B scaling curve): one summary
+    row per file keyed on the legacy dryrun fields (``n_devices`` /
+    ``rc`` / ``ok`` / ``skipped`` / ``tail``), then the newest record's
+    shard-count curve when it carries one. A file absent from the
+    series renders as a ``(missing)`` row instead of aborting — the
+    early points of a trajectory outlive the boxes that wrote them, and
+    one lost file must not hide the rest. Exits nonzero only when no
+    input loads at all."""
+    rows = []
+    for path in paths:
+        name = os.path.basename(path)
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+        except OSError:
+            rows.append((name, None, "(missing)"))
+            continue
+        except json.JSONDecodeError:
+            rows.append((name, None, "(unparseable)"))
+            continue
+        if not isinstance(rec, dict) or "n_devices" not in rec:
+            rows.append((name, None, "(no multichip record)"))
+            continue
+        rows.append((name, rec, None))
+    if not any(rec is not None for _, rec, _ in rows):
+        print(
+            "error: no readable MULTICHIP record among inputs",
+            file=sys.stderr,
+        )
+        return 2
+    header = (
+        f"{'record':<20} {'devices':>8} {'verdict':>8} {'states/s':>10}"
+        "  note"
+    )
+    out.write(header + "\n" + "-" * len(header) + "\n")
+    newest_curve = None
+    for name, rec, note in rows:
+        if rec is None:
+            out.write(f"{name:<20} {'-':>8} {'-':>8} {'-':>10}  {note}\n")
+            continue
+        verdict = (
+            "skipped" if rec.get("skipped")
+            else "ok" if rec.get("ok")
+            else f"rc={rec.get('rc')}"
+        )
+        value = rec.get("value")
+        rate = value if isinstance(value, (int, float)) and value else None
+        tail = (rec.get("tail") or "").strip()
+        tail_note = "" if rec.get("ok") else tail.splitlines()[-1][:44] \
+            if tail else ""
+        out.write(
+            f"{name:<20} {str(rec.get('n_devices', '-')):>8} "
+            f"{verdict:>8} {_fmt(rate):>10}  {tail_note}\n"
+        )
+        if isinstance(rec.get("curve"), list) and rec["curve"]:
+            newest_curve = (name, rec["curve"])
+    if newest_curve is None:
+        out.write(
+            "\n(no record carries a scaling curve yet — produce one "
+            "with bench.py --multichip)\n"
+        )
+        return 0
+    name, curve = newest_curve
+    out.write(f"\nscaling curve ({name}): sieve off vs on per width\n")
+    header = (
+        f"{'shards':>6} {'off /s':>10} {'on /s':>10} {'bit-id':>7} "
+        f"{'lanes/wave':>16} {'reduction':>10} {'kill':>6} {'fp':>9}"
+    )
+    out.write(header + "\n" + "-" * len(header) + "\n")
+    for point in curve:
+        off = point.get("sieve_off") or {}
+        on = point.get("sieve_on") or {}
+        coff, con = off.get("comms") or {}, on.get("comms") or {}
+        ident = point.get("bit_identical")
+        lanes = (
+            f"{coff['lanes_per_wave']:,.0f}->{con['lanes_per_wave']:,.0f}"
+            if "lanes_per_wave" in coff and "lanes_per_wave" in con
+            else "-"
+        )
+        reduction = point.get("lane_reduction_x")
+        kill = con.get("sieve_kill_rate")
+        probes, fps = con.get("bloom_probe_total"), con.get("bloom_fp_total")
+        fp_cell = f"{fps}/{probes}" if probes else "-"
+        out.write(
+            f"{str(point.get('n_shards', '-')):>6} "
+            f"{_fmt(off.get('rate')):>10} {_fmt(on.get('rate')):>10} "
+            f"{'yes' if ident else '-' if ident is None else 'NO':>7} "
+            f"{lanes:>16} "
+            f"{(str(reduction) + 'x') if reduction is not None else '-':>10} "
+            f"{f'{kill:.0%}' if kill is not None else '-':>6} "
+            f"{fp_cell:>9}\n"
+        )
+    diverged = [
+        str(p.get("n_shards"))
+        for p in curve
+        if p.get("bit_identical") is False
+    ]
+    if diverged:
+        out.write(
+            f"\nBIT-IDENTITY BROKEN at shard widths: {', '.join(diverged)}"
+            " — the sieve changed results; gate before trusting rates\n"
+        )
+        return 1
+    return 0
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(
         description="Per-leg rate deltas between bench trajectory files, "
@@ -587,6 +695,13 @@ def main(argv=None):
         "record",
     )
     parser.add_argument(
+        "--multichip", action="store_true",
+        help="render the pod-scale sharding trajectory across "
+        "MULTICHIP_r*.json records (per-file verdicts, then the newest "
+        "sieve A/B scaling curve); missing files render as rows, not "
+        "errors",
+    )
+    parser.add_argument(
         "--service-trajectory", action="store_true",
         help="render the concurrent-throughput trajectory across "
         "service bench records (time-sliced r10 vs tenant-packed r12+: "
@@ -594,6 +709,9 @@ def main(argv=None):
         "fill)",
     )
     args = parser.parse_args(argv)
+
+    if args.multichip:
+        return multichip_trajectory(args.files)
 
     if args.service_trajectory:
         return service_trajectory(args.files)

@@ -86,6 +86,73 @@ def collect_overlapped(events):
     return out
 
 
+# Cross-shard exchange args the sharded wave spans carry (sieve-and-
+# compact routing, PR-17): summed into the per-prefix comms ledger.
+COMMS_KEYS = (
+    "comms_probes",
+    "comms_killed",
+    "comms_bloom_probes",
+    "comms_bloom_hits",
+    "comms_bloom_fps",
+    "comms_lanes",
+    "comms_bytes",
+)
+
+
+def collect_comms(events):
+    """Per-prefix exchange-ledger sums from the wave/drain spans that
+    carry ``comms_*`` args: ``{prefix: {key: total}}``. Empty for
+    single-device traces and for sharded runs whose exchange shipped
+    nothing (a zero-lane trace has no ledger to render)."""
+    out = {}
+    for ev in events:
+        if ev.get("ph") != "X":
+            continue
+        args = ev.get("args") or {}
+        if "comms_lanes" not in args:
+            continue
+        prefix = ev.get("name", "").rsplit(".", 1)[0]
+        led = out.setdefault(prefix, dict.fromkeys(COMMS_KEYS, 0))
+        for key in COMMS_KEYS:
+            led[key] += int(args.get(key, 0) or 0)
+    return out
+
+
+def comms_block(c):
+    """The derived-rate view of one comms ledger (the ``--json`` shape):
+    raw sums plus sieve kill rate and the OBSERVED Bloom FP rate — the
+    audit number to hold against the filter's design bound."""
+    probes, killed = c["comms_probes"], c["comms_killed"]
+    bloom_probes, bloom_fps = c["comms_bloom_probes"], c["comms_bloom_fps"]
+    return {
+        **c,
+        "sieve_kill_rate": (killed / probes) if probes else None,
+        "bloom_fp_rate_observed": (
+            bloom_fps / bloom_probes if bloom_probes else None
+        ),
+    }
+
+
+def print_comms(prefix, c, out=sys.stdout):
+    out.write(
+        f"comms ledger: {prefix} — {c['comms_lanes']:,} lanes / "
+        f"{c['comms_bytes']:,} bytes shipped cross-shard\n"
+    )
+    probes, killed = c["comms_probes"], c["comms_killed"]
+    if probes:
+        out.write(
+            f"  sieve: {killed:,}/{probes:,} candidate lanes killed "
+            f"pre-exchange ({100.0 * killed / probes:.1f}%)\n"
+        )
+    bloom_probes, bloom_fps = c["comms_bloom_probes"], c["comms_bloom_fps"]
+    if bloom_probes:
+        out.write(
+            f"  bloom audit: {bloom_fps:,}/{bloom_probes:,} observed "
+            f"false positives ({100.0 * bloom_fps / bloom_probes:.3f}%)\n"
+        )
+    out.write("\n")
+
+
 def overlap_headroom(led):
     """The headroom block for one ledger: always non-null (zero host
     phases => zero headroom, predicted == measured)."""
@@ -191,6 +258,7 @@ def main(argv=None):
     events = load_events(args.trace)
     ledgers = collect_ledgers(events)
     overlapped = collect_overlapped(events)
+    comms = collect_comms(events)
     if not ledgers:
         print(
             f"no .pipeline attribution spans in {args.trace} — was the "
@@ -211,6 +279,11 @@ def main(argv=None):
                     if prefix in overlapped
                     else {}
                 ),
+                **(
+                    {"comms": comms_block(comms[prefix])}
+                    if prefix in comms
+                    else {}
+                ),
             }
             for prefix, led in sorted(ledgers.items())
         }
@@ -219,6 +292,8 @@ def main(argv=None):
         return 0
     for prefix, led in sorted(ledgers.items()):
         print_ledger(prefix, led, overlapped.get(prefix))
+        if prefix in comms:
+            print_comms(prefix, comms[prefix])
     return 0
 
 

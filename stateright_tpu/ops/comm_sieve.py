@@ -1,0 +1,148 @@
+"""Device-resident routing sieve for the sharded cross-shard exchange.
+
+The sharded checker routes every candidate fingerprint to its owner shard
+over ``lax.all_to_all`` each wave. Most of those candidates are re-visits:
+the owner's hash set rejects them and the lane was shipped for nothing. The
+sieve lets the *sender* drop lanes it can prove are already resident at
+their owner, before the collective, without changing any result bit.
+
+Two layers, maintained over the same key stream (every key this device has
+routed since the last storage eviction):
+
+1. **Receipt cache** — a direct-mapped table of ``2**slots_log2`` full
+   ``(hi, lo)`` key pairs. A probe hit compares the *entire* key, so there
+   are no false positives: a hit proves this device already routed exactly
+   this key, hence the owner inserted it, hence the full-width exchange
+   would have returned ``fresh=False`` for the lane. Dropping it is
+   bit-identical by construction. Collisions simply overwrite (last writer
+   wins); a stale miss only costs a redundant lane, never correctness.
+
+2. **Bloom filter** — a byte-per-bit array summarizing the same routed
+   keys. Bloom hits are *advisory only* (never drop a lane): the owner's
+   insert verdict for a routed lane is an exact membership re-check, so
+   ``bloom_hit & fresh`` counts true Bloom false positives with zero extra
+   probes. This is the observed-FP audit that sizes the filter honestly
+   (``comms.sieve.bloom_probe_total`` / ``bloom_fp_total``).
+
+Both structures are flushed (zeroed) whenever the owner tables themselves
+evict to host storage — after a flush, receipts only ever cover keys that
+are still resident in device hash sets, which keeps even the out-of-core
+per-lane fresh flags identical to the unsieved exchange.
+
+All functions are pure jnp (gather/scatter + word mixing) and trace inside
+``shard_map``; arrays are per-device (no replication of other shards'
+state — the receipt cache summarizes *this device's own* routing history,
+which is exactly the subset of the global visited set it can prove).
+
+The all-zero key pair is the hash-set empty sentinel upstream
+(``ops/fingerprint.py``) and doubles as the empty-slot sentinel here.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .fingerprint import avalanche32
+
+__all__ = [
+    "BLOOM_BITS_PER_KEY",
+    "BLOOM_NUM_HASHES",
+    "BLOOM_DESIGN_FP_RATE",
+    "cache_new",
+    "cache_probe",
+    "cache_insert",
+    "bloom_new",
+    "bloom_bits_for",
+    "bloom_probe",
+    "bloom_insert",
+]
+
+# Same design point as the storage-tier run Blooms (storage/runs.py):
+# 10 bits/key + 7 hashes => ~1% design false-positive rate at capacity.
+BLOOM_BITS_PER_KEY = 10
+BLOOM_NUM_HASHES = 7
+BLOOM_DESIGN_FP_RATE = 0.01
+
+_SALT_SLOT = jnp.uint32(0x9E3779B9)
+_SALT_H1 = jnp.uint32(0x85EBCA6B)
+_SALT_H2 = jnp.uint32(0xC2B2AE35)
+
+
+def _fold(hi: jax.Array, lo: jax.Array, salt: jax.Array) -> jax.Array:
+    """One avalanche over the 64-bit key folded with a lane salt."""
+    return avalanche32(avalanche32(hi ^ salt) ^ lo)
+
+
+def cache_new(slots_log2: int) -> jax.Array:
+    """An empty receipt cache: ``(2**slots_log2, 2)`` uint32, all zero."""
+    return jnp.zeros((1 << slots_log2, 2), jnp.uint32)
+
+
+def _cache_slot(hi: jax.Array, lo: jax.Array, slots: int) -> jax.Array:
+    return (_fold(hi, lo, _SALT_SLOT) & jnp.uint32(slots - 1)).astype(jnp.int32)
+
+
+def cache_probe(
+    cache: jax.Array, hi: jax.Array, lo: jax.Array, active: jax.Array
+) -> jax.Array:
+    """Exact membership of ``(hi, lo)`` in the receipt cache.
+
+    Full-key compare: a ``True`` is a proof the key was routed (and hence is
+    resident at its owner), never a hash coincidence. Inactive lanes return
+    ``False``. The reserved (0, 0) pair never enters the key stream, so an
+    empty slot cannot fake a hit.
+    """
+    slot = _cache_slot(hi, lo, cache.shape[0])
+    return active & (cache[slot, 0] == hi) & (cache[slot, 1] == lo)
+
+
+def cache_insert(
+    cache: jax.Array, hi: jax.Array, lo: jax.Array, mask: jax.Array
+) -> jax.Array:
+    """Records masked lanes' keys; direct-mapped, colliders overwrite."""
+    slot = _cache_slot(hi, lo, cache.shape[0])
+    guarded = jnp.where(mask, slot, cache.shape[0])
+    rows = jnp.stack([hi, lo], axis=-1)
+    return cache.at[guarded].set(rows, mode="drop")
+
+
+def bloom_bits_for(expected_keys: int) -> int:
+    """Filter width (power of two, bits) for an expected key population."""
+    want = max(64, expected_keys * BLOOM_BITS_PER_KEY)
+    bits = 64
+    while bits < want:
+        bits <<= 1
+    return bits
+
+
+def bloom_new(bits: int) -> jax.Array:
+    """An empty filter: one uint8 per bit (gather/scatter friendly)."""
+    assert bits & (bits - 1) == 0, "bloom width must be a power of two"
+    return jnp.zeros((bits,), jnp.uint8)
+
+
+def _bloom_indices(hi: jax.Array, lo: jax.Array, bits: int) -> jax.Array:
+    """(lanes, K) double-hashed probe positions: ``h1 + j*h2 (mod bits)``."""
+    h1 = _fold(hi, lo, _SALT_H1)
+    h2 = _fold(lo, hi, _SALT_H2) | jnp.uint32(1)  # odd => full-period stride
+    j = jnp.arange(BLOOM_NUM_HASHES, dtype=jnp.uint32)
+    idx = h1[..., None] + j * h2[..., None]
+    return (idx & jnp.uint32(bits - 1)).astype(jnp.int32)
+
+
+def bloom_probe(bloom: jax.Array, hi: jax.Array, lo: jax.Array) -> jax.Array:
+    """``True`` iff all K probe bits are set (maybe-present)."""
+    idx = _bloom_indices(hi, lo, bloom.shape[0])
+    return jnp.all(bloom[idx] != 0, axis=-1)
+
+
+def bloom_insert(
+    bloom: jax.Array, hi: jax.Array, lo: jax.Array, mask: jax.Array
+) -> jax.Array:
+    """Sets the K bits for every masked lane."""
+    idx = _bloom_indices(hi, lo, bloom.shape[0])
+    guarded = jnp.where(mask[..., None], idx, bloom.shape[0])
+    return bloom.at[guarded.reshape(-1)].set(jnp.uint8(1), mode="drop")

@@ -6,7 +6,35 @@ replacement — ``jax.sharding.Mesh`` + ``shard_map`` with XLA collectives
 doing the frontier/visited-set exchange over ICI/DCN (SURVEY §2.8).
 """
 
-from .base_mesh import AXIS, default_mesh
-from .sharded import ShardedTpuBfsChecker
+from .base_mesh import (
+    AXIS,
+    bootstrap_mesh,
+    default_mesh,
+    distributed_mesh,
+    initialize_distributed,
+)
 
-__all__ = ["AXIS", "ShardedTpuBfsChecker", "default_mesh"]
+__all__ = [
+    "AXIS",
+    "ShardedTpuBfsChecker",
+    "bootstrap_mesh",
+    "default_mesh",
+    "distributed_mesh",
+    "initialize_distributed",
+]
+
+
+def __getattr__(name):
+    # Lazy: importing the checker builds jnp module constants, i.e. runs
+    # a computation — which would poison multi-host processes that must
+    # call ``bootstrap_mesh()`` (jax.distributed.initialize) as their
+    # very first jax-touching act. Keeping this module light makes
+    # ``from stateright_tpu.parallel import bootstrap_mesh`` safe to run
+    # first in every controller process.
+    if name == "ShardedTpuBfsChecker":
+        from .sharded import ShardedTpuBfsChecker
+
+        return ShardedTpuBfsChecker
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}"
+    )

@@ -3,10 +3,17 @@
 The canonical mesh has one axis, ``"fp"`` — devices own fingerprint ranges
 of the visited set. On real hardware this spans the TPU slice (and hosts,
 under ``jax.distributed``); in tests it is the virtual 8-device CPU mesh.
+
+Multi-host entry point: call :func:`bootstrap_mesh` once per process (on a
+pod slice, or a multi-process CPU mesh in CI) — it initializes
+``jax.distributed`` idempotently and returns the global ``"fp"`` mesh over
+every device in the job. Single-process callers can keep using
+:func:`default_mesh` unchanged.
 """
 
 from __future__ import annotations
 
+import os
 from typing import Optional
 
 import numpy as np
@@ -15,6 +22,11 @@ import jax
 from jax.sharding import Mesh
 
 AXIS = "fp"
+
+# Set by initialize_distributed so repeat calls (idempotent bootstrap,
+# tests that re-enter) don't re-run jax.distributed.initialize, which
+# raises once a client exists.
+_DISTRIBUTED_STATE = {"initialized": False}
 
 
 def _pow2floor(n: int) -> int:
@@ -36,3 +48,91 @@ def default_mesh(n_devices: Optional[int] = None) -> Mesh:
             f"requested {n_devices} devices, only {len(devices)} available"
         )
     return Mesh(np.array(devices[:n_devices]), (AXIS,))
+
+
+def initialize_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    **kwargs,
+) -> bool:
+    """Idempotent ``jax.distributed.initialize`` for multi-host runs.
+
+    On TPU pods every argument auto-detects from the environment, so a
+    bare call does the right thing; multi-process CPU meshes (the CI leg)
+    pass coordinator/count/id explicitly. Returns ``True`` if this call
+    performed the initialization, ``False`` if a client already existed
+    (ours or anyone else's) — either way the process is usable afterwards.
+
+    Must run before any other jax API touches the backend; jax itself
+    enforces that, we just surface the error unchanged.
+    """
+    if _DISTRIBUTED_STATE["initialized"]:
+        return False
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+            **kwargs,
+        )
+    except RuntimeError as e:
+        # Already initialized elsewhere in this process: fine, adopt it.
+        if "already" in str(e).lower():
+            _DISTRIBUTED_STATE["initialized"] = True
+            return False
+        raise
+    _DISTRIBUTED_STATE["initialized"] = True
+    return True
+
+
+def distributed_mesh() -> Mesh:
+    """The global 1-D ``"fp"`` mesh over every device in the distributed
+    job (all processes), in ``jax.devices()`` order — the mesh the
+    sharded checker runs on after :func:`initialize_distributed`.
+
+    Unlike :func:`default_mesh` this never truncates to a power of two:
+    in a multi-process job every process must construct the IDENTICAL
+    mesh, and every device must belong to it (shard_map requires the
+    mesh to cover all addressable devices per process).
+    """
+    return Mesh(np.array(jax.devices()), (AXIS,))
+
+
+def bootstrap_mesh(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    **kwargs,
+) -> Mesh:
+    """One-call multi-host entry point: initialize ``jax.distributed``
+    (idempotently) and return the global ``"fp"`` mesh.
+
+    Convention for explicit (non-auto-detected) runs — e.g. the CI CPU
+    mesh — mirrors jax's own env fallbacks: arguments not passed are read
+    from ``JAX_COORDINATOR_ADDRESS`` / ``JAX_NUM_PROCESSES`` /
+    ``JAX_PROCESS_ID`` when set, else left to jax's auto-detection.
+    """
+    if coordinator_address is None:
+        coordinator_address = os.environ.get("JAX_COORDINATOR_ADDRESS")
+    if num_processes is None and "JAX_NUM_PROCESSES" in os.environ:
+        num_processes = int(os.environ["JAX_NUM_PROCESSES"])
+    if process_id is None and "JAX_PROCESS_ID" in os.environ:
+        process_id = int(os.environ["JAX_PROCESS_ID"])
+    initialize_distributed(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+        **kwargs,
+    )
+    if jax.process_count() > 1 and jax.default_backend() == "cpu":
+        # Gloo (the CPU backend's cross-process collectives) matches
+        # sends to receives by issue order, not tags. Async dispatch
+        # lets a dispatched executable's tail collectives overlap the
+        # next call's — two processes can then hit the wire in
+        # different orders and abort the job (gloo EnforceNotMet, size
+        # mismatch). Serial dispatch pins the wire order to program
+        # order. CPU-mesh stand-in only: TPU runtimes order their own
+        # collectives.
+        jax.config.update("jax_cpu_enable_async_dispatch", False)
+    return distributed_mesh()

@@ -62,10 +62,12 @@ from ..core.batch import BatchableModel
 from ..core.model import Expectation
 from ..core.path import Path
 from ..native import make_fingerprint_store
+from ..ops import comm_sieve
 from ..ops.fingerprint import fingerprint_state, fp64_pairs, fp_to_int
 from ..ops.hashset import MAX_PROBES, hashset_insert
 from ..ops.ring import ring_export, ring_push, ring_rows, ring_take
 from ..telemetry import (
+    CommsInstruments,
     WaveInstruments,
     device_step_annotation,
     get_tracer,
@@ -149,6 +151,10 @@ class ShardedTpuBfsChecker(Checker):
         run_id=None,
         async_pipeline=False,
         liveness=None,
+        wave_kernel="staged",
+        sieve=None,
+        sieve_slots_per_device=None,
+        sieve_bloom_bits=None,
     ):
         model = options.model
         if not isinstance(model, BatchableModel):
@@ -156,6 +162,28 @@ class ShardedTpuBfsChecker(Checker):
                 f"spawn_sharded_tpu_bfs requires a BatchableModel; "
                 f"{type(model).__name__} does not implement the packed protocol"
             )
+        # Honest capability surfacing (the single-device checker's
+        # packing_reason pattern): there is no sharded fused path — the
+        # Pallas megakernel fuses ONE device's wave into a single kernel
+        # and cannot express the cross-shard all_to_all key exchange —
+        # so asking for it refuses with the reason instead of silently
+        # dispatching the staged wave under a label that lies.
+        if wave_kernel not in ("staged", "fused"):
+            raise ValueError(
+                f"wave_kernel must be 'staged' or 'fused', got {wave_kernel!r}"
+            )
+        self._wave_kernel = "staged"
+        self.wave_kernel_reason = (
+            "wave_kernel='fused' has no sharded path: the fused Pallas "
+            "megakernel runs one device's wave as a single kernel and "
+            "cannot express the cross-shard all_to_all key exchange; "
+            "use the single-device checker for the fused engine, or "
+            "wave_kernel='staged' here"
+            if wave_kernel == "fused"
+            else None
+        )
+        if wave_kernel == "fused":
+            raise ValueError(self.wave_kernel_reason)
         # Run identity (checking-as-a-service): own metrics registry +
         # run-stamped trace spans, mirroring TpuBfsChecker.
         self.run_id = run_id
@@ -204,6 +232,32 @@ class ShardedTpuBfsChecker(Checker):
         # Probing masks with (capacity - 1): non-pow2 would address only a
         # subset of rows.
         self._cap_loc = _pow2ceil(table_capacity_per_device)
+        # Compression-and-sieve routing (README "Pod-scale sharding"):
+        # sieve=None resolves OFF — the rung-ladder exchange traces one
+        # branch per rung, a compile cost the many tiny sharded runs in
+        # the test tier cannot afford; dedicated tests and the multichip
+        # bench opt in explicitly. Results are bit-identical either way:
+        # the receipt cache only drops lanes whose key it re-checked in
+        # full, i.e. lanes the owner would have answered fresh=False.
+        self._sieve = bool(sieve) if sieve is not None else False
+        if sieve_slots_per_device is None:
+            sieve_slots_per_device = min(1 << 16, self._cap_loc)
+        self._sieve_slots = _pow2ceil(max(8, sieve_slots_per_device))
+        if sieve_bloom_bits is None:
+            # Sized for the resident population one shard can hold under
+            # the load cap (the filter is flushed whenever that
+            # population evicts), clamped to 1 MiB of bit-bytes.
+            sieve_bloom_bits = comm_sieve.bloom_bits_for(
+                min(int(_MAX_LOAD * self._cap_loc), 1 << 20)
+            )
+        if sieve_bloom_bits & (sieve_bloom_bits - 1):
+            raise ValueError(
+                f"sieve_bloom_bits must be a power of two, got "
+                f"{sieve_bloom_bits}"
+            )
+        self._sieve_bits = sieve_bloom_bits
+        self._sieve_dev = None
+        self._last_comms = None
         self._visitor = options._visitor
         self._target_state_count: Optional[int] = options._target_state_count
         self._depth_cap = options._target_max_depth or _DEPTH_INF
@@ -358,15 +412,27 @@ class ShardedTpuBfsChecker(Checker):
         # operands disappears. The export path (_jit_ring_export) is
         # deliberately NOT donated — checkpoints read the rings mid-run
         # and the pool must survive the call.
+        # With the sieve on, the wave and deep drain carry two extra
+        # sharded operands (receipt cache + Bloom filter) that are
+        # donated and rebound every call, like the table.
+        wave_in = (P("fp"),) * 7 + (P(),)
+        wave_donate = (0,)
+        deep_in = (P("fp"),) * 4 + (P(), P(), P())
+        deep_donate = (0, 1)
+        if self._sieve:
+            wave_in = wave_in + (P("fp"), P("fp"))
+            wave_donate = (0, 8, 9)
+            deep_in = deep_in + (P("fp"), P("fp"))
+            deep_donate = (0, 1, 7, 8)
         self._jit_wave = jax.jit(
             shard_map(
                 self._wave_local,
                 mesh=self._mesh,
-                in_specs=(P("fp"),) * 7 + (P(),),
+                in_specs=wave_in,
                 out_specs=P("fp"),
                 check_vma=False,
             ),
-            donate_argnums=(0,),
+            donate_argnums=wave_donate,
         )
         self._wave_exec = {}  # (local capacity, chunk width) -> AOT wave
         self._jit_insert = jax.jit(
@@ -395,11 +461,11 @@ class ShardedTpuBfsChecker(Checker):
             shard_map(
                 self._deep_drain_local,
                 mesh=self._mesh,
-                in_specs=(P("fp"),) * 4 + (P(), P(), P()),
+                in_specs=deep_in,
                 out_specs=P("fp"),
                 check_vma=False,
             ),
-            donate_argnums=(0, 1),
+            donate_argnums=deep_donate,
         )
         self._jit_ring_push = jax.jit(
             shard_map(
@@ -469,6 +535,10 @@ class ShardedTpuBfsChecker(Checker):
         # stateright_tpu.telemetry); occupancy is global across shards.
         # (Tracer/registry already bound above — run_id-scoped when set.)
         self._wi = WaveInstruments("sharded_bfs", registry=self._registry)
+        # Cross-shard exchange ledger — recorded sieve-on AND sieve-off
+        # (the unsieved wave ships the full width), so A/B runs compare
+        # lanes/bytes like for like.
+        self._ci = CommsInstruments("sharded_bfs", registry=self._registry)
         # Wave-timeline attribution (opt-in, telemetry/attribution.py):
         # same engine and phase names as TpuBfsChecker, prefixed
         # ``sharded_bfs`` — results stay bit-identical (fences change
@@ -518,26 +588,49 @@ class ShardedTpuBfsChecker(Checker):
         )
         group_start = jax.lax.cummax(jnp.where(is_start, lanes, 0))
         pos = lanes - group_start
-        dest = jnp.where(okey_s < n, okey_s * m + pos, n * m)
-        hi_s = hi[lane_s]
-        lo_s = lo[lane_s]
+        table_loc, fresh, _ack, overflow = self._exchange_at(
+            table_loc, hi[lane_s], lo[lane_s], lane_s, okey_s, pos, m, m,
+            want_ack=False,
+        )
+        return table_loc, fresh, overflow
+
+    def _exchange_at(
+        self, table_loc, hi_s, lo_s, lane_s, okey_s, pos, R, m,
+        want_ack=False,
+    ):
+        """The owner exchange + claim-insert at per-destination width
+        ``R`` (``R == m`` reproduces the historical full-width exchange
+        op for op). Inputs are the owner-sorted keys with within-group
+        offsets; outputs are per ORIGINAL lane.
+
+        Returns ``(table, fresh, acked, overflow)``. ``acked``
+        (``want_ack=True`` — the sieved path) marks lanes whose key is
+        provably resident at its owner after this exchange: claimed fresh
+        OR already found, but NOT probe-cap overflow. That is exactly the
+        receipt-cache admission condition — caching a pending
+        (overflowed) lane would kill its retry after the host grows the
+        table and lose the state. ``want_ack=False`` returns ``None`` for
+        it and keeps the legacy bool flag exchange untouched.
+        """
+        n = self._n
+        dest = jnp.where((okey_s < n) & (pos < R), okey_s * R + pos, n * R)
         send_hi = (
-            jnp.zeros((n * m,), jnp.uint32)
+            jnp.zeros((n * R,), jnp.uint32)
             .at[dest]
             .set(hi_s, mode="drop")
-            .reshape(n, m)
+            .reshape(n, R)
         )
         send_lo = (
-            jnp.zeros((n * m,), jnp.uint32)
+            jnp.zeros((n * R,), jnp.uint32)
             .at[dest]
             .set(lo_s, mode="drop")
-            .reshape(n, m)
+            .reshape(n, R)
         )
         src_slot = (
-            jnp.full((n * m,), m, jnp.int32)
+            jnp.full((n * R,), m, jnp.int32)
             .at[dest]
             .set(lane_s, mode="drop")
-            .reshape(n, m)
+            .reshape(n, R)
         )
 
         recv_hi = jax.lax.all_to_all(
@@ -547,18 +640,39 @@ class ShardedTpuBfsChecker(Checker):
             send_lo, "fp", split_axis=0, concat_axis=0, tiled=True
         )
 
-        rhi = recv_hi.reshape(n * m)
-        rlo = recv_lo.reshape(n * m)
+        rhi = recv_hi.reshape(n * R)
+        rlo = recv_lo.reshape(n * R)
         # (0, 0) is the bucket padding sentinel; fingerprints are never (0,0).
         ractive = (rhi != 0) | (rlo != 0)
         shi, slo, sidx, uniq = _sort_dedup(rhi, rlo, ractive)
-        table_loc, fresh_s, _found, pending = hashset_insert(
+        table_loc, fresh_s, found_s, pending = hashset_insert(
             table_loc, shi, slo, uniq
         )
         overflow = pending.sum()
+        if want_ack:
+            # Pack (fresh, resident) into one uint8 so the reverse
+            # exchange stays a single collective.
+            flags_s = fresh_s.astype(jnp.uint8) | (
+                (fresh_s | found_s).astype(jnp.uint8) << 1
+            )
+            flags_r = (
+                jnp.zeros((n * R,), jnp.uint8)
+                .at[sidx]
+                .set(flags_s)
+                .reshape(n, R)
+            )
+            back = jax.lax.all_to_all(
+                flags_r, "fp", split_axis=0, concat_axis=0, tiled=True
+            ).reshape(-1)
+            fl = (
+                jnp.zeros((m,), jnp.uint8)
+                .at[src_slot.reshape(-1)]
+                .set(back, mode="drop")
+            )
+            return table_loc, (fl & 1) != 0, (fl & 2) != 0, overflow
         # Un-sort fresh flags back to received order, then reverse-exchange.
         fresh_r = (
-            jnp.zeros((n * m,), bool).at[sidx].set(fresh_s).reshape(n, m)
+            jnp.zeros((n * R,), bool).at[sidx].set(fresh_s).reshape(n, R)
         )
         fresh_back = jax.lax.all_to_all(
             fresh_r, "fp", split_axis=0, concat_axis=0, tiled=True
@@ -568,7 +682,113 @@ class ShardedTpuBfsChecker(Checker):
             .at[src_slot.reshape(-1)]
             .set(fresh_back.reshape(-1), mode="drop")
         )
-        return table_loc, fresh, overflow
+        return table_loc, fresh, None, overflow
+
+    def _comm_rungs(self, m):
+        """Ascending per-destination exchange widths for an ``m``-lane
+        wave: a base-4 ladder from 8 lanes up (8, 32, 128, ...) capped by
+        the always-sufficient full width ``m`` (the bucket-ladder idiom
+        from the chunk dispatcher). Base 4 bounds overshoot at 4x the
+        survivor count while keeping the ``lax.switch`` branch count —
+        each branch traces its own all_to_all pair — at log4(m). One
+        mesh-agreed index (``pmax``) picks the rung, so peers never
+        diverge on the collective shape."""
+        rungs = []
+        r = 8
+        while r < m:
+            rungs.append(r)
+            r <<= 2
+        return rungs + [m]
+
+    def _route_insert_sieved(self, table_loc, hi, lo, valid, cache, bloom):
+        """``_route_insert`` with the sieve + compact stages in front of
+        the collective (ISSUE 17 tentpole; returns the updated sieve
+        state and the wave's comms vector alongside).
+
+        **Sieve** — the receipt cache re-checks the FULL key on a hit, so
+        a kill is a proof this device already routed the key and its
+        owner acked residency: the full-width exchange would answer
+        ``fresh=False``, which is precisely what a dropped lane reports.
+        No false positive exists to repair, so per-lane results are
+        bit-identical by construction. The Bloom filter over the same
+        routed keys never drops anything — it is the audited advisory
+        layer: for a routed lane the owner's verdict IS an exact
+        membership re-check, so ``bloom_hit & fresh`` counts true Bloom
+        false positives with zero extra probes.
+
+        **Compact** — survivors pack to a dense per-destination prefix
+        and the exchange runs at the smallest ladder rung holding the
+        mesh-max survivor count; every device takes the same
+        ``lax.switch`` branch (the rung index is a ``pmax``), so the
+        collectives inside the branches always match up.
+        """
+        n = self._n
+        m = hi.shape[0]
+        kill = comm_sieve.cache_probe(cache, hi, lo, valid)
+        bhit = comm_sieve.bloom_probe(bloom, hi, lo)
+        send = valid & ~kill
+
+        owner = (hi % jnp.uint32(n)).astype(jnp.int32)
+        lanes = jnp.arange(m, dtype=jnp.int32)
+        okey = jnp.where(send, owner, n)
+        okey_s, lane_s = jax.lax.sort((okey, lanes), num_keys=1)
+        is_start = jnp.concatenate(
+            [jnp.ones((1,), bool), okey_s[1:] != okey_s[:-1]]
+        )
+        group_start = jax.lax.cummax(jnp.where(is_start, lanes, 0))
+        pos = lanes - group_start
+        hi_s = hi[lane_s]
+        lo_s = lo[lane_s]
+        counts = jnp.zeros((n + 1,), jnp.int32).at[okey].add(1)
+        need = jax.lax.pmax(counts[:n].max(), "fp")
+        rungs = self._comm_rungs(m)
+        if len(rungs) == 1:
+            ridx = jnp.int32(0)
+            table_loc, fresh, ack, overflow = self._exchange_at(
+                table_loc, hi_s, lo_s, lane_s, okey_s, pos, m, m,
+                want_ack=True,
+            )
+        else:
+            # Smallest rung >= need; the top rung is m >= any count.
+            ridx = (need > jnp.asarray(rungs, jnp.int32)).sum().astype(
+                jnp.int32
+            )
+            branches = [
+                (
+                    lambda R: lambda tbl, a, b, c, d, e: self._exchange_at(
+                        tbl, a, b, c, d, e, R, m, want_ack=True
+                    )
+                )(R)
+                for R in rungs
+            ]
+            table_loc, fresh, ack, overflow = jax.lax.switch(
+                ridx, branches, table_loc, hi_s, lo_s, lane_s, okey_s, pos
+            )
+        # Receipts: only owner-acked lanes (see _exchange_at) enter the
+        # cache and filter — after this wave those keys ARE resident.
+        acked = send & ack
+        cache = comm_sieve.cache_insert(cache, hi, lo, acked)
+        bloom = comm_sieve.bloom_insert(bloom, hi, lo, acked)
+        shipped = n * jnp.asarray(rungs, jnp.int32)[ridx]
+        comms = jnp.concatenate(
+            [
+                jnp.stack(
+                    [
+                        valid.sum(dtype=jnp.int32),
+                        kill.sum(dtype=jnp.int32),
+                        send.sum(dtype=jnp.int32),
+                        (bhit & send).sum(dtype=jnp.int32),
+                        # Exact Bloom FPs: hit, routed, owner says fresh.
+                        (bhit & send & fresh).sum(dtype=jnp.int32),
+                        shipped,
+                    ]
+                ),
+                (jnp.arange(len(rungs), dtype=jnp.int32) == ridx).astype(
+                    jnp.int32
+                ),
+            ]
+        )
+        return table_loc, fresh, overflow, cache, bloom, comms
 
     def _insert_local(self, table, hi, lo, valid):
         """Standalone sharded insert (used to seed the initial states)."""
@@ -581,16 +801,25 @@ class ShardedTpuBfsChecker(Checker):
             "overflow": overflow[None],
         }
 
-    def _wave_local(self, table, states, hi, lo, ebits, depth, mask, depth_cap):
+    def _wave_local(
+        self, table, states, hi, lo, ebits, depth, mask, depth_cap,
+        cache=None, bloom=None,
+    ):
         """shard_map wrapper: unwraps the leading per-device axis, runs the
         wave core, and re-wraps scalars for ``out_specs=P("fp")``."""
         out = self._wave_core(
-            table[0], states, hi, lo, ebits, depth, mask, depth_cap
+            table[0], states, hi, lo, ebits, depth, mask, depth_cap,
+            cache=None if cache is None else cache[0],
+            bloom=None if bloom is None else bloom[0],
         )
         wrapped = dict(out)
         wrapped["table"] = out["table"][None]
         for k in ("generated", "n_new", "overflow", "max_depth"):
             wrapped[k] = out[k][None]
+        wrapped["comms"] = out["comms"][None]
+        if self._sieve:
+            wrapped["sieve_cache"] = out["sieve_cache"][None]
+            wrapped["sieve_bloom"] = out["sieve_bloom"][None]
         if self._properties:
             for k in ("prop_hit", "prop_hi", "prop_lo"):
                 wrapped[k] = out[k][None]
@@ -600,7 +829,10 @@ class ShardedTpuBfsChecker(Checker):
             wrapped["live_n"] = out["live_n"][None]
         return wrapped
 
-    def _wave_core(self, table_loc, states, hi, lo, ebits, depth, mask, depth_cap):
+    def _wave_core(
+        self, table_loc, states, hi, lo, ebits, depth, mask, depth_cap,
+        cache=None, bloom=None,
+    ):
         """One expansion wave on local (per-device) arrays: expand,
         fingerprint, pre-dedup, all-to-all claim-insert, compact. Scalars
         come back unwrapped; the deep drain and the wave-at-a-time wrapper
@@ -641,9 +873,27 @@ class ShardedTpuBfsChecker(Checker):
         # owner-side exchange carries no intra-device duplicates.
         _shi, _slo, sidx, uniq = _sort_dedup(khi, klo, cvalid_flat)
         route = jnp.zeros((B,), bool).at[sidx].set(uniq)
-        table_loc, fresh, overflow = self._route_insert(
-            table_loc, khi, klo, route
-        )
+        if self._sieve:
+            table_loc, fresh, overflow, cache, bloom, comms = (
+                self._route_insert_sieved(
+                    table_loc, khi, klo, route, cache, bloom
+                )
+            )
+        else:
+            table_loc, fresh, overflow = self._route_insert(
+                table_loc, khi, klo, route
+            )
+            # Uniform comms vector (layout as _route_insert_sieved's):
+            # the unsieved exchange ships the full n*B lanes per device
+            # at a single full-width "rung" — emitted even sieve-off so
+            # A/B runs compare ledgers like for like.
+            comms = jnp.concatenate(
+                [
+                    jnp.zeros((5,), jnp.int32),
+                    jnp.full((1,), self._n * B, jnp.int32),
+                    jnp.ones((1,), jnp.int32),
+                ]
+            )
 
         # Compact fresh candidates into the local next-frontier slots.
         pos = jnp.cumsum(fresh.astype(jnp.int32)) - 1
@@ -672,7 +922,11 @@ class ShardedTpuBfsChecker(Checker):
             * (jnp.arange(B) < fresh.sum()),
             "parent_hi": hi[parent_row] * (jnp.arange(B) < fresh.sum()),
             "parent_lo": lo[parent_row] * (jnp.arange(B) < fresh.sum()),
+            "comms": comms,
         }
+        if self._sieve:
+            out["sieve_cache"] = cache
+            out["sieve_bloom"] = bloom
         if self._symmetry_enabled:
             # Claimed visited-set keys, for checkpoint table rebuild.
             out["new_khi"] = zu.at[out_slot].set(khi, mode="drop")
@@ -851,7 +1105,8 @@ class ShardedTpuBfsChecker(Checker):
         return ok
 
     def _deep_drain_local(
-        self, table, pool, head, count, undiscovered, budget, depth_cap
+        self, table, pool, head, count, undiscovered, budget, depth_cap,
+        cache=None, bloom=None,
     ):
         """The sharded deep drain: consecutive waves inside one device
         ``while_loop``. Each iteration appends the previous wave's fresh
@@ -869,8 +1124,10 @@ class ShardedTpuBfsChecker(Checker):
         head0 = head[0]
         count0 = count[0]
         budget0 = budget
+        cache0 = None if cache is None else cache[0]
+        bloom0 = None if bloom is None else bloom[0]
 
-        def wave_plus(tbl, fr):
+        def wave_plus(tbl, fr, cache, bloom):
             out = self._wave_core(
                 tbl,
                 fr["states"],
@@ -880,6 +1137,8 @@ class ShardedTpuBfsChecker(Checker):
                 fr["depth"],
                 fr["mask"],
                 depth_cap,
+                cache=cache,
+                bloom=bloom,
             )
             rows = {
                 "states": out["new_states"],
@@ -900,7 +1159,7 @@ class ShardedTpuBfsChecker(Checker):
             self._PCl,
             F,
         )
-        out0 = wave_plus(table_loc, fr0)
+        out0 = wave_plus(table_loc, fr0, cache0, bloom0)
         zl = jnp.zeros((Ll,), jnp.uint32)
         log0 = {
             "child_hi": zl,
@@ -921,6 +1180,10 @@ class ShardedTpuBfsChecker(Checker):
             "generated": jnp.int32(0),
             "consumed_unique": jnp.int32(0),
             "max_depth": jnp.int32(0),
+            # int32 is fine here: lanes/wave × waves/drain stays well
+            # under 2^31 for any budget-bounded drain, and the vector is
+            # telemetry only — never feeds back into results.
+            "comms_acc": jnp.zeros_like(out0["comms"]),
             "budget": budget0,
             # The pre-loop wave (out0) counts against the cap too, so a
             # drain runs at most max_drain_waves waves total (the cap backs
@@ -972,7 +1235,12 @@ class ShardedTpuBfsChecker(Checker):
             frontier, head, count = ring_take(
                 pool, c["head"], count, self._PCl, F
             )
-            out = wave_plus(o["table"], frontier)
+            out = wave_plus(
+                o["table"],
+                frontier,
+                o["sieve_cache"] if self._sieve else None,
+                o["sieve_bloom"] if self._sieve else None,
+            )
             log_n = c["log_n"] + n_new
             budget = c["budget"] - jax.lax.psum(n_new, "fp")
             waves = c["waves"] + 1
@@ -988,6 +1256,7 @@ class ShardedTpuBfsChecker(Checker):
                 "generated": gen_acc,
                 "consumed_unique": c["consumed_unique"] + n_new,
                 "max_depth": jnp.maximum(c["max_depth"], o["max_depth"]),
+                "comms_acc": c["comms_acc"] + o["comms"],
                 "budget": budget,
                 "waves": waves,
                 "go": self._drain_decide(
@@ -1028,7 +1297,13 @@ class ShardedTpuBfsChecker(Checker):
                     o["max_depth"],
                 ]
             )[None],
+            # Consumed waves' exchange totals plus the final (unconsumed)
+            # wave's — same accounting boundary as cov_acc below.
+            "comms_acc": (res["comms_acc"] + o["comms"])[None],
         }
+        if self._sieve:
+            out["final"]["sieve_cache"] = o["sieve_cache"][None]
+            out["final"]["sieve_bloom"] = o["sieve_bloom"][None]
         if self._symmetry_enabled:
             out["final"]["new_khi"] = o["new_khi"]
             out["final"]["new_klo"] = o["new_klo"]
@@ -1073,6 +1348,23 @@ class ShardedTpuBfsChecker(Checker):
             ),
             out_shardings=self._shard,
         )()
+
+    def _new_sieve(self):
+        """Cold (flushed) sieve state, pre-sharded: one receipt cache and
+        one Bloom filter per device. Cold is always safe — kills only
+        become possible again as keys are re-routed and re-acked."""
+        return (
+            jax.jit(
+                lambda: jnp.zeros(
+                    (self._n, self._sieve_slots, 2), jnp.uint32
+                ),
+                out_shardings=self._shard,
+            )(),
+            jax.jit(
+                lambda: jnp.zeros((self._n, self._sieve_bits), jnp.uint8),
+                out_shardings=self._shard,
+            )(),
+        )
 
     def _grow_table(self, table, min_cap_loc, defer_evict=False):
         """Grows (or, under an HBM budget, evicts) every shard's table.
@@ -1130,15 +1422,23 @@ class ShardedTpuBfsChecker(Checker):
         between the surrounding wave verdicts (see TpuBfsChecker.
         _evict_l0)."""
         with self._phase("evict"):
-            tab = self._pull(table)  # (n, cap_loc + apron, 2)
-            shard_keys = []
-            for d in range(self._n):
-                sh = tab[d]
-                live = (sh[:, 0] != 0) | (sh[:, 1] != 0)
-                keys = (
-                    sh[live, 0].astype(np.uint64) << np.uint64(32)
-                ) | sh[live, 1].astype(np.uint64)
-                shard_keys.append(keys)
+            if self._mp:
+                # Compress stage (ISSUE 17): each process delta-encodes
+                # ITS shards' live keys with the storage/runs.py wire
+                # codec and the hosts exchange the compressed buffers —
+                # a few bytes per key over DCN instead of allgathering
+                # 8 B for every table slot, empty or not.
+                shard_keys = self._allgather_evicted_keys(table)
+            else:
+                tab = self._pull(table)  # (n, cap_loc + apron, 2)
+                shard_keys = []
+                for d in range(self._n):
+                    sh = tab[d]
+                    live = (sh[:, 0] != 0) | (sh[:, 1] != 0)
+                    keys = (
+                        sh[live, 0].astype(np.uint64) << np.uint64(32)
+                    ) | sh[live, 1].astype(np.uint64)
+                    shard_keys.append(keys)
             if defer and self._pipe is not None:
                 self._pipe.submit(
                     lambda ks=shard_keys: self._evict_absorb(ks)
@@ -1149,7 +1449,64 @@ class ShardedTpuBfsChecker(Checker):
             self._cap_loc = self._max_cap_loc
             self._l0_count = 0
             self._si.set_l0(0)
+            if self._sieve and self._sieve_dev is not None:
+                # Flush: receipts must only cover keys resident in the
+                # DEVICE tables. An evicted key re-routed later gets
+                # fresh=True from the unsieved exchange (the host-side
+                # tier probe filters it); a stale receipt would kill
+                # that lane and diverge from the sieve-off run.
+                self._sieve_dev = self._new_sieve()
             return self._new_table()
+
+    def _allgather_evicted_keys(self, table):
+        """Multi-controller eviction exchange: local shard-key extraction
+        plus a delta-compressed two-step allgather (lengths, then padded
+        byte rows). Every process returns the identical per-shard sorted
+        key lists, keeping the tier evictions SPMD across hosts."""
+        from jax.experimental import multihost_utils
+
+        from ..storage.runs import decode_sorted_fps, encode_sorted_fps
+
+        n = self._n
+        bufs = [b""] * n
+        for sh in table.addressable_shards:
+            d = sh.index[0].start or 0
+            data = np.asarray(sh.data)[0]  # (cap_loc + apron, 2)
+            live = (data[:, 0] != 0) | (data[:, 1] != 0)
+            keys = (
+                data[live, 0].astype(np.uint64) << np.uint64(32)
+            ) | data[live, 1].astype(np.uint64)
+            keys.sort()
+            bufs[d] = encode_sorted_fps(keys)
+        lens = np.array([len(b) for b in bufs], np.int64)
+        all_lens = np.asarray(
+            multihost_utils.process_allgather(lens)
+        ).reshape(-1, n)
+        width = max(1, int(all_lens.max()))
+        pad = np.zeros((n, width), np.uint8)
+        for d, b in enumerate(bufs):
+            pad[d, : len(b)] = np.frombuffer(b, np.uint8)
+        all_bufs = np.asarray(
+            multihost_utils.process_allgather(pad)
+        ).reshape(-1, n, width)
+        shard_keys = []
+        wire_bytes = 0
+        for d in range(n):
+            # Exactly one process owns shard d (its row is the only
+            # non-empty one; an empty shard still carries the codec
+            # header, so ownership is unambiguous).
+            p = int(all_lens[:, d].argmax())
+            ln = int(all_lens[p, d])
+            shard_keys.append(decode_sorted_fps(all_bufs[p, d, :ln].tobytes()))
+            wire_bytes += ln
+        self._ci.evict_wire_bytes.inc(wire_bytes)
+        self._tracer.instant(
+            "sharded_bfs.evict_wire",
+            bytes=wire_bytes,
+            raw_bytes=int(table.shape[0]) * int(table.shape[1]) * 8,
+            keys=int(sum(len(k) for k in shard_keys)),
+        )
+        return shard_keys
 
     def _evict_absorb(self, shard_keys):
         """Pipeline-worker half of a deferred eviction (all shards)."""
@@ -1179,13 +1536,24 @@ class ShardedTpuBfsChecker(Checker):
             return np.asarray(multihost_utils.process_allgather(x, tiled=True))
         return np.asarray(x)
 
+    def _put_sharded(self, x):
+        """One host value onto the ``"fp"``-sharded layout. Every process
+        passes the identical value (SPMD over hosts), so each one
+        materializes just its addressable shards from it — device_put of
+        an uncommitted array onto a non-fully-addressable sharding would
+        instead broadcast-and-compare the full value through the
+        coordination mesh per leaf per wave (jax's assert_equal guard),
+        a collective storm the gloo DCN stand-in cannot keep in lockstep
+        with the wave loop's own exchanges."""
+        if self._mp:
+            arr = np.asarray(x)
+            return jax.make_array_from_callback(
+                arr.shape, self._shard, lambda idx, a=arr: a[idx]
+            )
+        return jax.device_put(jnp.asarray(x), self._shard)
+
     def _put_chunk(self, arrs):
-        # Multi-controller note: every process passes the identical host
-        # value, so device_put shards out each host's addressable slice
-        # consistently.
-        return jax.tree_util.tree_map(
-            lambda x: jax.device_put(jnp.asarray(x), self._shard), arrs
-        )
+        return jax.tree_util.tree_map(self._put_sharded, arrs)
 
     # The host pool is a deque of harvested row-batches; only the rows that
     # feed the next chunk are ever copied (a single running array would cost
@@ -1273,6 +1641,12 @@ class ShardedTpuBfsChecker(Checker):
             table = self._restore(self._resume_from)
         else:
             table = self._seed()
+        if self._sieve:
+            # Cold sieve at run start — seed and resume alike. Receipts
+            # only ever accumulate from keys THIS run routed and the
+            # owner acked, which is the invariant the bit-identity
+            # argument rests on; cold is always safe (no kills).
+            self._sieve_dev = self._new_sieve()
         depth_cap = jnp.int32(self._depth_cap)
         # Deep drain is off for visitors, target counts, and depth caps:
         # ring scheduling is only approximately global-FIFO across devices,
@@ -1560,10 +1934,11 @@ class ShardedTpuBfsChecker(Checker):
                             self._l0_count,
                             self._n * self._cap_loc,
                             self._max_depth,
-                        ):
+                        ),
+                        cm=self._last_comms:
                             self._harvest_verdict(
                                 ctx, w, nn, t, f, chunks, width, bucket,
-                                got, warm, st,
+                                got, warm, st, cm,
                             )
                     )
                 except BaseException:
@@ -1589,7 +1964,7 @@ class ShardedTpuBfsChecker(Checker):
                 attempt += 1
 
     def _harvest_verdict(self, ctx, wave, n_new, total, final, wave_no,
-                         width, bucket, got, warm, state):
+                         width, bucket, got, warm, state, comms=None):
         """Pipeline-worker half of a sharded wave: pulls the compacted
         fresh rows, probes the shard tiers (exact here — every eviction
         is applied on this thread, in submission order), logs the
@@ -1627,7 +2002,7 @@ class ShardedTpuBfsChecker(Checker):
                     bucket=bucket,
                     compaction_ratio=(got / width if bucket else None),
                     live_lanes=got, stale=ctx["stale"], warm=warm,
-                    state=state,
+                    state=state, comms=comms,
                 )
         finally:
             # Decrement even on a verdict error: the barrier predicate
@@ -1677,6 +2052,8 @@ class ShardedTpuBfsChecker(Checker):
             dev["mask"],
             jnp.asarray(depth_cap, jnp.int32),
         )
+        if self._sieve:
+            args = args + self._sieve_dev
         key = (table.shape[0], dev["hi"].shape[0])
         exe = self._wave_exec.get(key)
         if exe is None:
@@ -1689,11 +2066,43 @@ class ShardedTpuBfsChecker(Checker):
             if self.warmup_seconds is not None:
                 self.warmup_seconds += time.perf_counter() - t0
         if self._attr is None:
-            return exe(*args)
-        with self._attr.phase("device"):
             out = exe(*args)
-            self._attr.fence(out)
+        else:
+            with self._attr.phase("device"):
+                out = exe(*args)
+                self._attr.fence(out)
+        if self._sieve:
+            # The sieve operands are donated: rebind before anything can
+            # touch the stale references.
+            self._sieve_dev = (out["sieve_cache"], out["sieve_bloom"])
+        self._consume_comms(
+            out["comms"], dev["hi"].shape[0] // self._n * self._A
+        )
         return out
+
+    def _consume_comms(self, comms, m):
+        """Host accounting for one dispatch's mesh-summed comms vector
+        (layout: ``[sieve_probes, killed, bloom_probes, bloom_hits,
+        bloom_fps, lanes_shipped, rung one-hot...]`` per shard; ``m`` is
+        the per-device candidate-lane width, which fixes the rung
+        ladder). Returns (and stashes) the span-args dict the wave span
+        rides."""
+        c = np.asarray(self._pull(comms), np.int64).sum(axis=0)
+        args = self._ci.record(
+            probes=int(c[0]),
+            killed=int(c[1]),
+            bloom_probes=int(c[2]),
+            bloom_hits=int(c[3]),
+            bloom_fps=int(c[4]),
+            lanes=int(c[5]),
+        )
+        rungs = self._comm_rungs(m) if self._sieve else [m]
+        for i, width in enumerate(rungs[: max(0, len(c) - 6)]):
+            cnt = int(c[6 + i])
+            if cnt:
+                self._ci.rung_dispatch(width, cnt)
+        self._last_comms = args
+        return args
 
     # -- deep-drain host loop ---------------------------------------------
 
@@ -1813,6 +2222,8 @@ class ShardedTpuBfsChecker(Checker):
                     budget,
                     depth_cap,
                 )
+                if self._sieve:
+                    args = args + self._sieve_dev
                 if not compiled:
                     # AOT-compile so the first drain (which may run the whole
                     # exploration) doesn't fold into any warmup measurement.
@@ -1847,6 +2258,9 @@ class ShardedTpuBfsChecker(Checker):
                     # wave is accounted by _consume_final below.
                     self._wi.drains.inc()
                     self._wi.waves.inc(int(dstats[:, 4].max()))
+                    comms_extra = self._consume_comms(
+                        res["comms_acc"], self._F_loc * self._A
+                    )
                     self._wi.record(
                         drain_span,
                         frontier=self._G,
@@ -1861,8 +2275,14 @@ class ShardedTpuBfsChecker(Checker):
                         # Live pending states across all rings — the monitor's
                         # progress fit reads this, not the capacity `frontier`.
                         ring_count=int(dstats[:, 5].sum()),
+                        **comms_extra,
                     )
                 pool, head, count = res["pool"], res["head"], res["count"]
+                if self._sieve:
+                    fin = res["final"]
+                    self._sieve_dev = (
+                        fin["sieve_cache"], fin["sieve_bloom"]
+                    )
                 ring_est = int(dstats[:, 5].max())
                 if self._cov is not None:
                     # Every drain wave (final included — see
@@ -2074,10 +2494,7 @@ class ShardedTpuBfsChecker(Checker):
         while True:
             out = self._jit_insert(
                 table,
-                *(
-                    jax.device_put(jnp.asarray(a), self._shard)
-                    for a in (khi, klo, valid)
-                ),
+                *(self._put_sharded(a) for a in (khi, klo, valid)),
             )
             if not int(self._pull(out["overflow"]).sum()):
                 break
@@ -2314,10 +2731,7 @@ class ShardedTpuBfsChecker(Checker):
             while True:
                 out = self._jit_insert(
                     table,
-                    *(
-                        jax.device_put(jnp.asarray(a), self._shard)
-                        for a in (bh, bl, valid)
-                    ),
+                    *(self._put_sharded(a) for a in (bh, bl, valid)),
                 )
                 table = out["table"]
                 self._l0_count += int(self._pull(out["fresh"]).sum())
@@ -2423,7 +2837,7 @@ class ShardedTpuBfsChecker(Checker):
     def _record_wave_metrics(
         self, span, frontier, generated, n_new, bucket=None,
         compaction_ratio=None, live_lanes=None, stale=None, warm=None,
-        state=None,
+        state=None, comms=None,
     ):
         """One host-visible wave's telemetry (the shared bundle does the
         recording; occupancy is the shard tables' resident load — under
@@ -2438,6 +2852,14 @@ class ShardedTpuBfsChecker(Checker):
             # Live (pre-padding) pending rows: the monitor's frontier fit
             # reads this over the dispatch-width `frontier` when present.
             extra["live_lanes"] = live_lanes
+        # Exchange ledger args (comms_lanes, sieve kill/FP counts...) ride
+        # the wave span for the attribution report and gap_report. The
+        # async verdict passes its capture; the sync path reads the last
+        # dispatch's (growth retries overwrite — last attempt's is the
+        # one whose rows this span's n_new describes).
+        cm = comms if comms is not None else self._last_comms
+        if cm:
+            extra.update(cm)
         if state is not None:
             l0, capacity, depth = state
         else:
@@ -2570,7 +2992,16 @@ class ShardedTpuBfsChecker(Checker):
             warmup_seconds=getattr(self, "warmup_seconds", None),
             checkpoint_path=self._checkpoint_path,
             preempted=self.preempted,
+            wave_kernel=self._wave_kernel,
+            sieve=self._sieve,
         )
+        if self.wave_kernel_reason is not None:
+            digest["wave_kernel_reason"] = self.wave_kernel_reason
+        if self._sieve:
+            digest["comm_sieve"] = {
+                "cache_slots": self._sieve_slots,
+                "bloom_bits": self._sieve_bits,
+            }
         if self._si is not None:
             try:
                 digest["storage"] = self._si.bench_stats()

@@ -28,7 +28,14 @@ See README "Memory hierarchy" for the knobs and when eviction pays.
 
 from .bloom import BloomFilter
 from .edge_log import LivenessEdgeStore, LivenessInstruments
-from .runs import RUN_BLOCK, FingerprintRun, decode_varint_u64, encode_varint_u64
+from .runs import (
+    RUN_BLOCK,
+    FingerprintRun,
+    decode_sorted_fps,
+    decode_varint_u64,
+    encode_sorted_fps,
+    encode_varint_u64,
+)
 from .tiered import (
     StorageInstruments,
     TenantPartitions,
@@ -46,7 +53,9 @@ __all__ = [
     "StorageInstruments",
     "TenantPartitions",
     "TieredVisitedStore",
+    "decode_sorted_fps",
     "decode_varint_u64",
+    "encode_sorted_fps",
     "encode_varint_u64",
     "max_table_rows_for_budget",
     "validate_budget_knobs",

@@ -37,6 +37,8 @@ __all__ = [
     "FingerprintRun",
     "encode_varint_u64",
     "decode_varint_u64",
+    "encode_sorted_fps",
+    "decode_sorted_fps",
 ]
 
 # Keys per block: 4096 keys ≈ a few KiB compressed — one block decode per
@@ -88,6 +90,48 @@ def decode_varint_u64(buf: bytes) -> np.ndarray:
             data[starts[sel] + i] & np.uint8(0x7F)
         ).astype(np.uint64) << np.uint64(7 * i)
     return vals
+
+
+# -- cross-host wire codec -------------------------------------------------
+#
+# The sharded checker's inter-host paths (multi-process eviction exchange,
+# fleet spill) ship sorted fingerprint batches between processes. The wire
+# frame is the same sorted-delta varint stream the runs use, framed with a
+# magic + count header so a truncated or mis-routed buffer fails loudly
+# instead of decoding into garbage keys.
+
+_WIRE_MAGIC = b"FPD1"
+
+
+def encode_sorted_fps(fps: np.ndarray) -> bytes:
+    """Frames a SORTED (ascending, distinct) u64 fingerprint batch as
+    ``b"FPD1" + <u4 count> + varint(deltas)`` where ``deltas[0]`` is the
+    first key absolute and the rest are consecutive differences. An empty
+    batch is the 8-byte header alone."""
+    fps = np.ascontiguousarray(fps, np.uint64)
+    header = _WIRE_MAGIC + np.uint32(len(fps)).tobytes()
+    if len(fps) == 0:
+        return header
+    deltas = np.empty(len(fps), np.uint64)
+    deltas[0] = fps[0]
+    # uint64 subtraction wraps mod 2**64; cumsum on decode wraps back, so
+    # the round trip is exact even if the input is (wrongly) unsorted.
+    np.subtract(fps[1:], fps[:-1], out=deltas[1:])
+    return header + encode_varint_u64(deltas)
+
+
+def decode_sorted_fps(buf: bytes) -> np.ndarray:
+    """Inverse of :func:`encode_sorted_fps`; validates frame + count."""
+    if len(buf) < 8 or buf[:4] != _WIRE_MAGIC:
+        raise ValueError("bad fingerprint wire frame (magic mismatch)")
+    count = int(np.frombuffer(buf[4:8], np.uint32)[0])
+    deltas = decode_varint_u64(buf[8:])
+    if len(deltas) != count:
+        raise ValueError(
+            f"fingerprint wire frame declares {count} keys, "
+            f"payload decodes {len(deltas)}"
+        )
+    return np.cumsum(deltas, dtype=np.uint64)
 
 
 class FingerprintRun:

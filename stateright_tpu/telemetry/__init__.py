@@ -23,7 +23,12 @@ simulation, the TPU wave/drain loops, and the sharded mesh checker).
 
 from .attribution import WaveAttribution
 from .coverage import CoverageLedger, DeviceCoverage
-from .instruments import BlockInstruments, TenantInstruments, WaveInstruments
+from .instruments import (
+    BlockInstruments,
+    CommsInstruments,
+    TenantInstruments,
+    WaveInstruments,
+)
 from .metrics import (
     Counter,
     Gauge,
@@ -75,6 +80,7 @@ def __getattr__(name):
 
 __all__ = [
     "BlockInstruments",
+    "CommsInstruments",
     "Counter",
     "CoverageLedger",
     "DeviceCoverage",

@@ -105,6 +105,86 @@ class WaveInstruments:
             )
 
 
+class CommsInstruments:
+    """Cross-shard exchange accounting for the sharded checker, named
+    ``<prefix>.comms.*``. One bundle per sharded run; fed from the wave
+    kernel's per-wave comms vector (sieve kills, Bloom audit, compacted
+    rung) so the ledger reflects what the collectives actually shipped,
+    not what the host thinks they should have."""
+
+    def __init__(self, prefix: str, registry: MetricsRegistry = None):
+        reg = registry if registry is not None else metrics_registry()
+        p = f"{prefix}.comms"
+        self._prefix = p
+        self._registry = reg
+        # Lanes that entered the router vs lanes the receipt cache proved
+        # already-visited and dropped before the all_to_all.
+        self.sieve_probes = reg.counter(f"{p}.sieve.probes")
+        self.sieve_killed = reg.counter(f"{p}.sieve.killed")
+        # Bloom audit: routed lanes double as exact membership re-checks
+        # (the owner's insert verdict), so FPs are counted, not estimated.
+        self.bloom_probes = reg.counter(f"{p}.sieve.bloom_probe_total")
+        self.bloom_fps = reg.counter(f"{p}.sieve.bloom_fp_total")
+        # What the collectives shipped: key lanes (8B out + 1B flag back
+        # each) across all destinations, post-compaction.
+        self.lanes_shipped = reg.counter(f"{p}.lanes_shipped")
+        self.bytes_shipped = reg.counter(f"{p}.bytes_shipped")
+        # Delta-compressed bytes the multi-host eviction exchange put on
+        # the wire (storage/runs.py codec) — vs raw 8 B/slot allgather.
+        self.evict_wire_bytes = reg.counter(f"{p}.evict_wire_bytes")
+        self.kill_rate = reg.gauge(f"{p}.sieve.kill_rate")
+        self.fp_rate = reg.gauge(f"{p}.sieve.bloom_fp_rate")
+        # Per-rung dispatch counters, lazy like bucket_dispatch.
+        self._rung_counters = {}
+
+    # Wire cost per shipped lane: 8 key bytes out + 1 fresh-flag byte back.
+    LANE_BYTES = 9
+
+    def rung_dispatch(self, width: int, n: int = 1) -> None:
+        """Counts ``n`` exchanges at rung ``width`` lanes per destination
+        (``<prefix>.comms.rung_dispatch.<width>``)."""
+        c = self._rung_counters.get(width)
+        if c is None:
+            c = self._registry.counter(
+                f"{self._prefix}.rung_dispatch.{width}"
+            )
+            self._rung_counters[width] = c
+        c.inc(n)
+
+    def record(
+        self,
+        *,
+        probes: int,
+        killed: int,
+        bloom_probes: int,
+        bloom_hits: int,
+        bloom_fps: int,
+        lanes: int,
+    ) -> dict:
+        """One wave's (or drain-aggregate's) exchange totals. Returns the
+        span-args dict so the caller can ride it on the wave span (the
+        attribution ledger and ``gap_report`` read it from there)."""
+        self.sieve_probes.inc(probes)
+        self.sieve_killed.inc(killed)
+        self.bloom_probes.inc(bloom_probes)
+        self.bloom_fps.inc(bloom_fps)
+        self.lanes_shipped.inc(lanes)
+        self.bytes_shipped.inc(lanes * self.LANE_BYTES)
+        if probes:
+            self.kill_rate.set(killed / probes)
+        if bloom_probes:
+            self.fp_rate.set(bloom_fps / bloom_probes)
+        return {
+            "comms_probes": probes,
+            "comms_killed": killed,
+            "comms_bloom_probes": bloom_probes,
+            "comms_bloom_hits": bloom_hits,
+            "comms_bloom_fps": bloom_fps,
+            "comms_lanes": lanes,
+            "comms_bytes": lanes * self.LANE_BYTES,
+        }
+
+
 class BlockInstruments:
     """Counters/histogram for a host engine's per-block loop
     (``bfs.block`` / ``dfs.block`` / ``on_demand.block``)."""

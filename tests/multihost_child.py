@@ -1,11 +1,27 @@
-"""Child process for the multi-controller (multi-host) sharded test.
+"""Child process for the multi-controller (multi-host) sharded tests.
 
-Each of two processes owns 4 virtual CPU devices; ``jax.distributed``
-joins them into one 8-device mesh spanning both. The sharded checker then
-runs SPMD-over-hosts: both processes execute the same host loop, jit
-dispatches agree, and host pulls allgather (``ShardedTpuBfsChecker._pull``).
+Each of two processes owns 4 virtual CPU devices; the ``bootstrap_mesh``
+entry point (``parallel/base_mesh.py``) initializes ``jax.distributed``
+from the ``JAX_COORDINATOR_ADDRESS`` / ``JAX_NUM_PROCESSES`` /
+``JAX_PROCESS_ID`` convention and returns the joint 8-device ``"fp"``
+mesh spanning both. The sharded checker then runs SPMD-over-hosts: both
+processes execute the same host loop, jit dispatches agree, and host
+pulls allgather (``ShardedTpuBfsChecker._pull``).
 
-Usage: ``python multihost_child.py <process_id> <coordinator_port>``.
+Usage: ``python multihost_child.py <process_id> <coordinator_port> [mode]``
+
+Modes:
+- ``plain`` (default) — 2pc-3, full-width exchange.
+- ``sieve``           — 2pc-3 with the compression-and-sieve routing on
+                        (receipt-cache kills + rung-compacted exchange).
+- ``evict_exchange``  — the multi-process delta-compressed eviction
+                        allgather (``_allgather_evicted_keys``) driven
+                        directly over a synthetic sharded table with
+                        known per-shard keys; both controllers must
+                        decode the identical ground truth.
+
+The output line carries counts AND the shipped-lane tally so the driver
+can gate bit-identity and the sieve's traffic reduction across modes.
 """
 
 import os
@@ -13,36 +29,122 @@ import sys
 
 pid = int(sys.argv[1])
 port = sys.argv[2]
+mode = sys.argv[3] if len(sys.argv) > 3 else "plain"
 os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+os.environ["JAX_COORDINATOR_ADDRESS"] = f"localhost:{port}"
+os.environ["JAX_NUM_PROCESSES"] = "2"
+os.environ["JAX_PROCESS_ID"] = str(pid)
 
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-jax.distributed.initialize(
-    f"localhost:{port}", num_processes=2, process_id=pid
-)
-
-import numpy as np
-from jax.sharding import Mesh
+# Cross-process collectives on the CPU backend (the DCN stand-in); without
+# this the first multiprocess computation fails to compile.
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# Bootstrap BEFORE any model/checker import: jax.distributed must
+# initialize before the first computation touches the backend.
+from stateright_tpu.parallel import bootstrap_mesh
+from stateright_tpu.utils.compile_cache import enable_persistent_cache
+
+# Config-only (safe pre-init); both children share the cache — jax's
+# atomic writes make the concurrent misses race-free — so the sieve leg
+# reuses the plain leg's base programs instead of recompiling them.
+enable_persistent_cache()
+
+mesh = bootstrap_mesh()
+
 from stateright_tpu.models.two_phase_commit import TwoPhaseSys
+from stateright_tpu.telemetry.metrics import metrics_registry
 
 assert len(jax.devices()) == 8, jax.devices()
 assert len(jax.local_devices()) == 4
+assert mesh.devices.size == 8
 
-mesh = Mesh(np.array(jax.devices()), ("fp",))
-checker = (
-    TwoPhaseSys(3)
-    .checker()
-    .spawn_sharded_tpu_bfs(
-        mesh=mesh, frontier_per_device=32, table_capacity_per_device=512
+if mode == "evict_exchange":
+    # Drive the compress stage of the tentpole directly: a synthetic
+    # (n, rows, 2) table with known per-shard keys, sharded over the
+    # real 2-process mesh, pushed through the production
+    # _allgather_evicted_keys. Covers the two-step lens/bytes
+    # allgather, the header-only empty-shard ownership case, and the
+    # codec's value extremes — and both controllers must decode the
+    # identical per-shard key lists. (The full out-of-core run is kept
+    # single-process; see test_multihost.py for why.)
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from stateright_tpu.parallel.sharded import ShardedTpuBfsChecker
+    from stateright_tpu.telemetry.instruments import CommsInstruments
+    from stateright_tpu.telemetry.trace import get_tracer
+
+    n, rows = 8, 256
+    mult = np.uint64(0x9E3779B97F4A7C15)  # odd => bijection mod 2^64
+    full = np.zeros((n, rows, 2), np.uint32)
+    truth = []
+    for d in range(n):
+        if d == 5:
+            # Empty shard: its owner still ships the 8-byte codec
+            # header, which is what disambiguates ownership.
+            truth.append(np.zeros(0, np.uint64))
+            continue
+        count = 40 + 17 * d
+        keys = (np.arange(1, count + 1, dtype=np.uint64)
+                + np.uint64(d * 1000)) * mult
+        if d == 0:
+            keys[0] = np.uint64(1)  # hi word all-zero, still live
+            keys[1] = np.uint64(2**64 - 1)  # codec's max delta reach
+        assert len(np.unique(keys)) == count
+        slots = (np.arange(count) * 7) % rows  # 7 coprime to 256
+        full[d, slots, 0] = (keys >> np.uint64(32)).astype(np.uint32)
+        full[d, slots, 1] = (keys & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+        truth.append(np.sort(keys))
+    table = jax.make_array_from_callback(
+        full.shape,
+        NamedSharding(mesh, PartitionSpec("fp")),
+        lambda idx: full[idx],
     )
-    .join()
-)
+    obj = object.__new__(ShardedTpuBfsChecker)
+    obj._n = n
+    obj._ci = CommsInstruments("sharded_bfs")
+    obj._tracer = get_tracer()
+    shard_keys = obj._allgather_evicted_keys(table)
+    assert len(shard_keys) == n
+    for d in range(n):
+        got = np.asarray(shard_keys[d], np.uint64)
+        assert np.array_equal(got, truth[d]), (d, got, truth[d])
+    wire = int(
+        metrics_registry()
+        .snapshot()
+        .get("sharded_bfs.comms.evict_wire_bytes", 0)
+    )
+    raw = full.size * full.itemsize
+    assert 0 < wire < raw, (wire, raw)
+    total = int(sum(len(k) for k in truth))
+    print(
+        f"MULTIHOST-OK pid={pid} count={total} states={total} "
+        f"depth=0 lanes={wire}",
+        flush=True,
+    )
+    sys.exit(0)
+
+kw = dict(frontier_per_device=32, table_capacity_per_device=512)
+if mode == "sieve":
+    kw["sieve"] = True
+model, expected = TwoPhaseSys(3), 288
+
+checker = model.checker().spawn_sharded_tpu_bfs(mesh=mesh, **kw).join()
 err = checker.worker_error()
 assert err is None, err
-assert checker.unique_state_count() == 288, checker.unique_state_count()
+count = checker.unique_state_count()
+assert count == expected, count
 checker.assert_properties()
-print(f"MULTIHOST-OK pid={pid} count=288", flush=True)
+snap = metrics_registry().snapshot()
+lanes = snap.get("sharded_bfs.comms.lanes_shipped", 0)
+print(
+    f"MULTIHOST-OK pid={pid} count={count} "
+    f"states={checker.state_count()} depth={checker.max_depth()} "
+    f"lanes={lanes}",
+    flush=True,
+)

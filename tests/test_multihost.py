@@ -1,19 +1,24 @@
 """Multi-controller execution: the sharded checker over a mesh spanning
 two PROCESSES (the local stand-in for multi-host TPU pods — same
-``jax.distributed`` path, DCN collectives replaced by Gloo over CPU).
+``jax.distributed`` path, DCN collectives replaced by Gloo over CPU),
+entered through the ``bootstrap_mesh`` entry point.
 
 SURVEY §2.8 / PARITY "known gaps": the reference has no distributed
 checking at all; this validates ours end to end — cross-process
 ``all_to_all``/``psum`` inside the deep drain, allgathered host pulls,
-and exact oracle counts on both controllers.
+and exact oracle counts on both controllers. The sieve leg additionally
+gates the compression-and-sieve routing: identical counts/depths to the
+full-width exchange (bit-identity) with strictly fewer shipped lanes.
 """
 
+import os
+import re
 import socket
 import subprocess
 import sys
-import os
+import time
 
-
+import pytest
 
 
 def _free_port():
@@ -24,7 +29,10 @@ def _free_port():
     return port
 
 
-def test_two_process_mesh_exact_count():
+def _run_pair(mode, timeout=390):
+    """Launches the two-process mesh in ``mode``; returns the parsed
+    ``MULTIHOST-OK`` fields (identical across pids, asserted) plus the
+    wall time — the CI leg reports timing as advisory, not a gate."""
     port = _free_port()
     child = os.path.join(os.path.dirname(__file__), "multihost_child.py")
     # Children must NOT inherit this process's single-device pin or its
@@ -34,9 +42,10 @@ def test_two_process_mesh_exact_count():
         for k, v in os.environ.items()
         if k not in ("XLA_FLAGS", "JAX_PLATFORMS")
     }
+    t0 = time.perf_counter()
     procs = [
         subprocess.Popen(
-            [sys.executable, child, str(i), str(port)],
+            [sys.executable, child, str(i), str(port), mode],
             stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT,
             env=env,
@@ -46,12 +55,95 @@ def test_two_process_mesh_exact_count():
     outs = []
     try:
         for p in procs:
-            out, _ = p.communicate(timeout=390)
+            out, _ = p.communicate(timeout=timeout)
             outs.append(out.decode())
     finally:
         for p in procs:
             if p.poll() is None:
                 p.kill()
+    wall = time.perf_counter() - t0
+    fields = []
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"process {i} failed:\n{out[-3000:]}"
-        assert f"MULTIHOST-OK pid={i} count=288" in out, out[-3000:]
+        m = re.search(
+            rf"MULTIHOST-OK pid={i} count=(\d+) states=(\d+) "
+            rf"depth=(\d+) lanes=(\d+)",
+            out,
+        )
+        assert m, out[-3000:]
+        fields.append(tuple(int(g) for g in m.groups()))
+    assert fields[0] == fields[1], f"controllers disagree: {fields}"
+    return fields[0], wall
+
+
+# Each pair-launch costs two cold jax processes (imports + distributed
+# init + compiles), which dominates wall time on small CI boxes — so the
+# full-width baseline runs ONCE and both tests read it from here.
+_PLAIN = {}
+
+
+def _plain_pair():
+    if "fields" not in _PLAIN:
+        _PLAIN["fields"], _PLAIN["wall"] = _run_pair("plain")
+    return _PLAIN["fields"], _PLAIN["wall"]
+
+
+# The full-run legs are `slow`: each pair costs ~30-60s of compile on a
+# small box, which blows the flat `-m 'not slow'` tier-1 budget. CI
+# still runs them every push — the tier1.yml multi-process smoke step
+# invokes this file with `-m 'slow or not slow'`. The evict_exchange
+# leg below stays fast, so the flat suite always crosses a real process
+# boundary (bootstrap_mesh + gloo allgathers) at least once.
+
+
+@pytest.mark.slow
+def test_two_process_mesh_exact_count():
+    (count, _, _, _), wall = _plain_pair()
+    assert count == 288
+    print(f"[advisory] plain 2-process wall: {wall:.1f}s")
+
+
+@pytest.mark.slow
+def test_two_process_mesh_sieve_bit_identical():
+    """Sieve on vs off across a real 2-process mesh: same counts, same
+    depth (bit-identity gate), strictly fewer shipped lanes. Timing is
+    printed as an advisory, never asserted — CI machines vary."""
+    plain, wall_off = _plain_pair()
+    sieved, wall_on = _run_pair("sieve")
+    assert sieved[:3] == plain[:3], (plain, sieved)
+    assert sieved[3] < plain[3], (
+        f"sieve shipped {sieved[3]} lanes, full-width {plain[3]}"
+    )
+    print(
+        f"[advisory] sieve off {wall_off:.1f}s / on {wall_on:.1f}s; "
+        f"lanes {plain[3]} -> {sieved[3]}"
+    )
+
+
+def test_two_process_evict_exchange():
+    """The compressed eviction path across a real 2-process mesh: the
+    child drives ``_allgather_evicted_keys`` over a synthetic sharded
+    table with known per-shard keys and asserts both controllers decode
+    the identical ground truth; the parsed line carries the decoded key
+    total and the wire byte count (in the ``lanes`` slot), compared
+    across pids by ``_run_pair`` and bounded here against the raw table
+    size (8 shards x 256 rows x 8 B).
+
+    A full out-of-core run (hbm budget tripping mid-run, ~10 small
+    collectives/wave over ~140 waves) is deliberately NOT exercised
+    across processes: it trips an upstream XLA:CPU gloo limitation —
+    sends are matched to receives by connection slot order, not tags,
+    so overlapped small collectives sporadically abort with
+    EnforceNotMet size mismatches long before any eviction runs
+    (host-side wave traces were verified bit-identical across the two
+    controllers, sieve on and off). Out-of-core correctness is covered
+    single-process by test_comm_sieve.py::
+    test_sieve_out_of_core_eviction_flushes; this leg pins the one
+    genuinely cross-process piece, the compressed exchange itself."""
+    (keys, _, _, wire), wall = _run_pair("evict_exchange", timeout=180)
+    assert keys == 671  # sum of 40 + 17*d over the 7 non-empty shards
+    assert 0 < wire < 8 * 256 * 8
+    print(
+        f"[advisory] evict-exchange 2-process wall: {wall:.1f}s, "
+        f"wire {wire} B vs raw {8 * 256 * 8} B"
+    )
