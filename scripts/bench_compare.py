@@ -655,6 +655,158 @@ def multichip_trajectory(paths, out=sys.stdout):
     return 0
 
 
+def slo_trajectory(paths, out=sys.stdout):
+    """The serving-latency trajectory across service bench records
+    (r10 time-sliced -> r12 tenant-packed -> r18 SLO ledger): one row
+    per file with its best-available ttfv evidence — the full
+    queue/compile/explore decomposition where the record carries an
+    ``slo`` block (BENCH_r18+), the bare p50/p99 ttfv where it only has
+    the legacy service keys (r10/r12), the swarm ttfv where only a
+    swarm record exists (r15). A file absent from the series renders as
+    a ``(missing)`` row instead of aborting — matching ``--multichip``:
+    early trajectory points outlive the boxes that wrote them, and one
+    lost file must not hide the rest. Exits nonzero only when no input
+    loads at all. After the table, the newest ``slo`` block renders
+    per-mode."""
+    rows = []
+    newest_slo = None
+    for path in paths:
+        name = os.path.basename(path)
+        try:
+            with open(path) as f:
+                text = f.read()
+        except OSError:
+            rows.append((name, None, "(missing)"))
+            continue
+        rec = None
+        for line in text.splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                obj = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(obj, dict):
+                rec = obj
+        if rec is None:
+            rows.append((name, None, "(unparseable)"))
+            continue
+        slo = rec.get("slo")
+        if isinstance(slo, dict) and slo.get("modes"):
+            modes = {
+                m: v
+                for m, v in slo["modes"].items()
+                if (v.get("jobs") or 0) > 0
+            }
+            view = modes.get("packed") or (
+                next(iter(modes.values())) if modes else None
+            )
+            if view is None:
+                rows.append((name, None, "(empty slo ledger)"))
+                continue
+            d = view.get("decomposition") or {}
+            rows.append((name, {
+                "source": "slo ledger",
+                "jobs": sum(v.get("jobs", 0) for v in modes.values()),
+                "p50": view["ttfv"].get("p50_s"),
+                "p99": view["ttfv"].get("p99_s"),
+                "queue": (d.get("queue_s") or {}).get("p50_s"),
+                "compile": (d.get("compile_s") or {}).get("p50_s"),
+                "explore": (d.get("explore_s") or {}).get("p50_s"),
+            }, None))
+            newest_slo = (name, slo)
+        elif "p50_ttfv_s" in rec:
+            rows.append((name, {
+                "source": "packed" if rec.get("packed") else "sliced",
+                "jobs": rec.get("jobs"),
+                "p50": rec.get("p50_ttfv_s"),
+                "p99": rec.get("p99_ttfv_s"),
+                "queue": None, "compile": None, "explore": None,
+            }, None))
+        elif isinstance(rec.get("swarm"), dict):
+            raft = rec["swarm"].get("raft3_check_live") or {}
+            rows.append((name, {
+                "source": "swarm",
+                "jobs": None,
+                "p50": raft.get("swarm_ttfv_s"),
+                "p99": None,
+                "queue": None, "compile": None, "explore": None,
+            }, None))
+        else:
+            rows.append((name, None, "(no ttfv data)"))
+    if not any(r is not None for _, r, _ in rows):
+        print(
+            "error: no readable ttfv/SLO record among inputs",
+            file=sys.stderr,
+        )
+        return 2
+
+    def cell(v, spec="{:.3f}"):
+        return "-" if v is None else spec.format(v)
+
+    header = (
+        f"{'record':<18} {'source':>11} {'jobs':>5} {'ttfv p50':>9} "
+        f"{'ttfv p99':>9} {'queue':>8} {'compile':>8} {'explore':>8}"
+        "  note"
+    )
+    out.write(header + "\n" + "-" * len(header) + "\n")
+    for name, r, note in rows:
+        if r is None:
+            out.write(
+                f"{name:<18} {'-':>11} {'-':>5} {'-':>9} {'-':>9} "
+                f"{'-':>8} {'-':>8} {'-':>8}  {note}\n"
+            )
+            continue
+        out.write(
+            f"{name:<18} {r['source']:>11} {str(r['jobs'] or '-'):>5} "
+            f"{cell(r['p50']):>9} {cell(r['p99']):>9} "
+            f"{cell(r['queue']):>8} {cell(r['compile']):>8} "
+            f"{cell(r['explore']):>8}\n"
+        )
+    if newest_slo is None:
+        out.write(
+            "\n(no record carries an SLO ledger yet — produce one with "
+            "bench.py --slo)\n"
+        )
+        return 0
+    name, slo = newest_slo
+    targets = slo.get("targets") or {}
+    tgt = (
+        ", ".join(f"{k} <= {v}s" for k, v in sorted(targets.items()))
+        if targets
+        else "none"
+    )
+    out.write(
+        f"\nper-mode ledger ({name}; targets: {tgt})\n"
+    )
+    header = (
+        f"{'mode':<12} {'jobs':>5} {'ttfv p50':>9} {'ttfv p99':>9} "
+        f"{'queue':>8} {'compile':>8} {'explore':>8} {'burn':>12}"
+    )
+    out.write(header + "\n" + "-" * len(header) + "\n")
+    for mode, view in slo["modes"].items():
+        if not (view.get("jobs") or 0):
+            continue
+        d = view.get("decomposition") or {}
+        burn = view.get("burn_rate") or {}
+        burn_cell = (
+            ", ".join(f"{k} {v:.1f}x" for k, v in sorted(burn.items()))
+            if burn
+            else "-"
+        )
+        out.write(
+            f"{mode:<12} {view.get('jobs', 0):>5} "
+            f"{cell(view['ttfv'].get('p50_s')):>9} "
+            f"{cell(view['ttfv'].get('p99_s')):>9} "
+            f"{cell((d.get('queue_s') or {}).get('p50_s')):>8} "
+            f"{cell((d.get('compile_s') or {}).get('p50_s')):>8} "
+            f"{cell((d.get('explore_s') or {}).get('p50_s')):>8} "
+            f"{burn_cell:>12}\n"
+        )
+    return 0
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(
         description="Per-leg rate deltas between bench trajectory files, "
@@ -702,6 +854,13 @@ def main(argv=None):
         "errors",
     )
     parser.add_argument(
+        "--slo", action="store_true",
+        help="render the serving-latency trajectory across service "
+        "bench records (r10/r12 ttfv -> r18 SLO ledger with "
+        "queue/compile/explore decomposition); missing files render as "
+        "rows, not errors",
+    )
+    parser.add_argument(
         "--service-trajectory", action="store_true",
         help="render the concurrent-throughput trajectory across "
         "service bench records (time-sliced r10 vs tenant-packed r12+: "
@@ -712,6 +871,9 @@ def main(argv=None):
 
     if args.multichip:
         return multichip_trajectory(args.files)
+
+    if args.slo:
+        return slo_trajectory(args.files)
 
     if args.service_trajectory:
         return service_trajectory(args.files)

@@ -153,6 +153,169 @@ def print_comms(prefix, c, out=sys.stdout):
     out.write("\n")
 
 
+# Per-shard fleet columns the sharded wave/drain spans carry
+# (telemetry/fleet.py FLEET_COLS — kept in sync by the tier-1 fleet
+# report test). Stdlib fold: trace files outlive the runs (and the
+# numpy installs) that wrote them.
+FLEET_KEYS = (
+    "live_lanes",
+    "generated",
+    "fresh",
+    "insert_load",
+    "overflow",
+    "routed",
+    "sieve_hits",
+    "probe_ms",
+    "evict_ms",
+    "evict_bytes",
+)
+
+
+def collect_fleet(events):
+    """Per-prefix per-shard sums + slowest-wave tallies from the spans
+    carrying ``fleet_*`` columns: ``{prefix: {"shards": n, "hosts": h,
+    "waves": W, "cost_waves": C, "totals": {col: [per-shard]},
+    "slowest": [per-shard]}}``. Empty for non-fleet traces."""
+    out = {}
+    for ev in events:
+        if ev.get("ph") != "X":
+            continue
+        args = ev.get("args") or {}
+        n = args.get("fleet_shards")
+        if not n:
+            continue
+        n = int(n)
+        prefix = ev.get("name", "").rsplit(".", 1)[0]
+        f = out.setdefault(prefix, {
+            "shards": n,
+            "hosts": int(args.get("fleet_hosts") or 1),
+            "waves": 0,
+            "cost_waves": 0,
+            "totals": {k: [0.0] * n for k in FLEET_KEYS},
+            "slowest": [0] * n,
+        })
+        try:
+            f["waves"] += max(1, int(args.get("waves") or 1))
+        except (TypeError, ValueError):
+            f["waves"] += 1
+        rows = {}
+        for key in FLEET_KEYS:
+            col = args.get(f"fleet_{key}")
+            if isinstance(col, list) and len(col) == n:
+                rows[key] = [float(x) for x in col]
+                tot = f["totals"][key]
+                for d, x in enumerate(rows[key]):
+                    tot[d] += float(x)
+        # The wave's cost vector: host tier wall when any shard paid one
+        # (time dominates), owner-side insert load otherwise — the same
+        # straggler definition as the live fold.
+        host = [
+            rows.get("probe_ms", [0.0] * n)[d]
+            + rows.get("evict_ms", [0.0] * n)[d]
+            for d in range(n)
+        ]
+        cost = host if sum(host) > 0 else rows.get(
+            "insert_load", rows.get("live_lanes", [0.0] * n)
+        )
+        if sum(cost) > 0:
+            f["cost_waves"] += 1
+            f["slowest"][cost.index(max(cost))] += 1
+    return out
+
+
+def _skew(values):
+    mean = sum(values) / len(values) if values else 0.0
+    if mean <= 0.0:
+        return None
+    var = sum((v - mean) ** 2 for v in values) / len(values)
+    return {
+        "max_over_mean": max(values) / mean,
+        "cv": var ** 0.5 / mean,
+    }
+
+
+def fleet_block(f):
+    """The derived skew/straggler view of one fleet fold (the
+    ``--json`` shape): per-shard totals, run-total skew per column, and
+    the slowest shards ranked by summed cost."""
+    n = f["shards"]
+    per_host = max(1, n // max(1, f["hosts"]))
+    host_ms = [
+        f["totals"]["probe_ms"][d] + f["totals"]["evict_ms"][d]
+        for d in range(n)
+    ]
+    cost = host_ms if sum(host_ms) > 0 else f["totals"]["insert_load"]
+    total_cost = sum(cost) or 1.0
+    order = sorted(range(n), key=lambda d: -cost[d])
+    stragglers = [
+        {
+            "shard": d,
+            "host": d // per_host,
+            "share": cost[d] / total_cost,
+            "score": n * cost[d] / total_cost,
+            "slowest_waves": f["slowest"][d],
+            "persistence": (
+                f["slowest"][d] / f["cost_waves"]
+                if f["cost_waves"]
+                else 0.0
+            ),
+        }
+        for d in order[:2]
+    ]
+    return {
+        "shards": n,
+        "hosts": f["hosts"],
+        "waves": f["waves"],
+        "per_shard": [
+            {
+                "shard": d,
+                "host": d // per_host,
+                **{k: f["totals"][k][d] for k in FLEET_KEYS},
+            }
+            for d in range(n)
+        ],
+        "skew": {
+            k: s
+            for k in ("live_lanes", "fresh", "insert_load", "probe_ms")
+            if (s := _skew(f["totals"][k])) is not None
+        },
+        "stragglers": stragglers,
+    }
+
+
+def print_fleet(prefix, f, out=sys.stdout):
+    b = fleet_block(f)
+    out.write(
+        f"fleet skew: {prefix} — {b['shards']} shards / "
+        f"{b['hosts']} host(s), {b['waves']} waves\n"
+    )
+    cols = ("live_lanes", "fresh", "insert_load", "probe_ms", "evict_ms")
+    header = "  " + f"{'shard':>5}" + "".join(
+        f"{c:>13}" for c in cols
+    )
+    out.write(header + "\n")
+    out.write("  " + "-" * (len(header) - 2) + "\n")
+    for row in b["per_shard"]:
+        out.write(
+            f"  {row['shard']:>5}"
+            + "".join(f"{row[c]:>13,.1f}" for c in cols)
+            + "\n"
+        )
+    for col, s in b["skew"].items():
+        out.write(
+            f"  skew[{col}]: max/mean {s['max_over_mean']:.2f}, "
+            f"cv {s['cv']:.2f}\n"
+        )
+    for i, st in enumerate(b["stragglers"]):
+        out.write(
+            f"  {'straggler' if i == 0 else 'runner-up'}: shard "
+            f"{st['shard']} (host {st['host']}) — {100 * st['share']:.1f}% "
+            f"of cost, slowest in {st['slowest_waves']}/"
+            f"{f['cost_waves']} waves\n"
+        )
+    out.write("\n")
+
+
 def overlap_headroom(led):
     """The headroom block for one ledger: always non-null (zero host
     phases => zero headroom, predicted == measured)."""
@@ -253,16 +416,25 @@ def main(argv=None):
         "--json", action="store_true",
         help="emit the ledgers as JSON instead of tables",
     )
+    parser.add_argument(
+        "--fleet", action="store_true",
+        help="also render the per-shard fleet skew / straggler view "
+        "(sharded runs with fleet=True)",
+    )
     args = parser.parse_args(argv)
 
     events = load_events(args.trace)
     ledgers = collect_ledgers(events)
     overlapped = collect_overlapped(events)
     comms = collect_comms(events)
-    if not ledgers:
+    fleet = collect_fleet(events) if args.fleet else {}
+    if not ledgers and not fleet:
+        hint = (
+            " or fleet columns" if args.fleet else ""
+        )
         print(
-            f"no .pipeline attribution spans in {args.trace} — was the "
-            "run spawned with attribution=True?",
+            f"no .pipeline attribution spans{hint} in {args.trace} — was "
+            "the run spawned with attribution=True?",
             file=sys.stderr,
         )
         return 1
@@ -287,6 +459,8 @@ def main(argv=None):
             }
             for prefix, led in sorted(ledgers.items())
         }
+        for prefix, f in sorted(fleet.items()):
+            out.setdefault(prefix, {})["fleet"] = fleet_block(f)
         json.dump(out, sys.stdout, indent=2)
         sys.stdout.write("\n")
         return 0
@@ -294,6 +468,8 @@ def main(argv=None):
         print_ledger(prefix, led, overlapped.get(prefix))
         if prefix in comms:
             print_comms(prefix, comms[prefix])
+    for prefix, f in sorted(fleet.items()):
+        print_fleet(prefix, f)
     return 0
 
 

@@ -108,6 +108,9 @@ def summarize(rec):
             )
         },
         "per_job": per_job,
+        # SLO ledger (BENCH_r18+ / any record carrying a GET /slo
+        # snapshot): rendered as its own table; absent on older records.
+        "slo": rec.get("slo"),
     }
 
 
@@ -203,6 +206,51 @@ def render(summary, out=sys.stdout):
         )
 
 
+def print_slo(slo, out=sys.stdout):
+    """The per-mode SLO table (records carrying a ``GET /slo``
+    snapshot — ``service/slo.py``); a compact sibling of
+    ``slo_report.py``'s full rendering."""
+    w = out.write
+    modes = {
+        m: v
+        for m, v in (slo.get("modes") or {}).items()
+        if (v.get("jobs") or 0) > 0
+    }
+    if not modes:
+        return
+    targets = slo.get("targets") or {}
+    tgt = (
+        " (targets: "
+        + ", ".join(f"{k} <= {v}s" for k, v in sorted(targets.items()))
+        + ")"
+        if targets
+        else ""
+    )
+    w(f"\n  slo ledger{tgt}\n")
+    header = (
+        f"  {'mode':<12} {'jobs':>5} {'ttfv p50':>9} {'ttfv p99':>9} "
+        f"{'queue p50':>10} {'compile p50':>12} {'explore p50':>12}\n"
+    )
+    w(header)
+    w("  " + "-" * (len(header) - 3) + "\n")
+    for mode, view in modes.items():
+        d = view.get("decomposition") or {}
+        w(
+            f"  {mode:<12} {view.get('jobs', 0):>5} "
+            f"{_fmt(view['ttfv'].get('p50_s'), '{:.3f}'):>9} "
+            f"{_fmt(view['ttfv'].get('p99_s'), '{:.3f}'):>9} "
+            f"{_fmt((d.get('queue_s') or {}).get('p50_s'), '{:.3f}'):>10} "
+            f"{_fmt((d.get('compile_s') or {}).get('p50_s'), '{:.3f}'):>12} "
+            f"{_fmt((d.get('explore_s') or {}).get('p50_s'), '{:.3f}'):>12}\n"
+        )
+        burn = view.get("burn_rate")
+        if burn:
+            rendered = ", ".join(
+                f"{k} {v:.2f}x" for k, v in sorted(burn.items())
+            )
+            w(f"    burn rate: {rendered} (1.0 = at budget)\n")
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(
         description="Render a bench.py --service record "
@@ -230,6 +278,8 @@ def main(argv=None):
         sys.stdout.write("\n")
     else:
         render(summary)
+        if summary.get("slo"):
+            print_slo(summary["slo"])
     return 0
 
 

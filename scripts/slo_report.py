@@ -1,0 +1,174 @@
+#!/usr/bin/env python
+"""Per-mode SLO latency report from a bench/service record.
+
+    python scripts/slo_report.py BENCH_r18.json [--json]
+
+Reads the JSON line ``bench.py --slo`` prints (saved as
+``BENCH_r18.json``), or any record carrying an ``"slo"`` block — the
+``GET /slo`` snapshot shape (``service/slo.py``) — and renders the
+end-to-end latency attribution per verification mode: rolling p50/p99
+ttfv and verdict latency, the queue/compile/explore ttfv decomposition
+(clamped to partition ttfv exactly), and burn rates against the
+record's targets when they were set.
+
+``--json`` emits the summary as one JSON object instead of the tables
+(machine-readable; the tests consume it) — the convention shared by
+``gap_report.py`` / ``service_report.py`` / ``storage_report.py``.
+Stdlib-only, like every report reader here: bench records outlive the
+runs that wrote them.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+MODES = ("exhaustive", "swarm", "packed")
+
+
+def load_record(path):
+    """The SLO record from a bench JSON file: the last parseable JSON
+    line carrying an ``slo`` block (files may hold stderr noise or a
+    wrapper line ahead of the record). A bare ``GET /slo`` snapshot
+    (top-level ``modes``) is accepted too."""
+    record = None
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                obj = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if not isinstance(obj, dict):
+                continue
+            if isinstance(obj.get("slo"), dict):
+                record = obj
+            elif "modes" in obj and "objective" in obj:
+                record = {"slo": obj}
+    return record
+
+
+def summarize(rec):
+    slo = rec.get("slo") or {}
+    modes = slo.get("modes") or {}
+    return {
+        "model": rec.get("model"),
+        "device": rec.get("device"),
+        "jobs_per_mode": rec.get("jobs_per_mode"),
+        "targets": slo.get("targets") or {},
+        "objective": slo.get("objective"),
+        "window": slo.get("window"),
+        "decomposition_partitions": rec.get("decomposition_partitions"),
+        "modes": {
+            m: modes[m]
+            for m in MODES
+            if m in modes and (modes[m].get("jobs") or 0) > 0
+        },
+    }
+
+
+def _fmt(v, spec="{:.3f}", none="-"):
+    if v is None:
+        return none
+    return spec.format(v)
+
+
+def render(summary, out=sys.stdout):
+    w = out.write
+    targets = summary["targets"]
+    tgt = (
+        ", ".join(f"{k} <= {v}s" for k, v in sorted(targets.items()))
+        if targets
+        else "none (observational)"
+    )
+    w(
+        f"slo ledger: {summary['model'] or '?'} on "
+        f"{summary['device'] or '?'} — targets: {tgt}"
+        + (
+            f" @ {summary['objective']:.0%} objective"
+            if targets and summary.get("objective") is not None
+            else ""
+        )
+        + "\n\n"
+    )
+    if not summary["modes"]:
+        w("  (no served jobs in any mode)\n")
+        return
+    header = (
+        f"  {'mode':<12} {'jobs':>5} {'ttfv p50':>9} {'ttfv p99':>9} "
+        f"{'queue p50':>10} {'compile p50':>12} {'explore p50':>12} "
+        f"{'verdict p50':>12} {'verdict p99':>12}\n"
+    )
+    w(header)
+    w("  " + "-" * (len(header) - 3) + "\n")
+    for mode, view in summary["modes"].items():
+        d = view.get("decomposition") or {}
+        w(
+            f"  {mode:<12} {view.get('jobs', 0):>5} "
+            f"{_fmt(view['ttfv'].get('p50_s')):>9} "
+            f"{_fmt(view['ttfv'].get('p99_s')):>9} "
+            f"{_fmt((d.get('queue_s') or {}).get('p50_s')):>10} "
+            f"{_fmt((d.get('compile_s') or {}).get('p50_s')):>12} "
+            f"{_fmt((d.get('explore_s') or {}).get('p50_s')):>12} "
+            f"{_fmt(view['verdict'].get('p50_s')):>12} "
+            f"{_fmt(view['verdict'].get('p99_s')):>12}\n"
+        )
+    w("\n")
+    any_burn = False
+    for mode, view in summary["modes"].items():
+        burn = view.get("burn_rate")
+        if burn:
+            any_burn = True
+            rendered = ", ".join(
+                f"{k} {v:.2f}x" for k, v in sorted(burn.items())
+            )
+            w(f"  burn rate [{mode}]: {rendered} (1.0 = at budget)\n")
+    if not any_burn and targets:
+        w("  burn rate: no observations against targets yet\n")
+    parts = summary.get("decomposition_partitions")
+    if parts:
+        bad = sorted(m for m, ok in parts.items() if not ok)
+        w(
+            "  decomposition: queue + compile + explore partitions ttfv "
+            + (
+                "in every mode\n"
+                if not bad
+                else f"EXCEPT {', '.join(bad)}\n"
+            )
+        )
+    w("\n")
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="Render a bench.py --slo record (per-mode ttfv/"
+        "verdict percentiles + decomposition + burn rates)."
+    )
+    parser.add_argument("record", help="BENCH_r18.json / /slo snapshot JSON")
+    parser.add_argument(
+        "--json", action="store_true",
+        help="emit the summary as one JSON object (machine-readable)",
+    )
+    args = parser.parse_args(argv)
+    rec = load_record(args.record)
+    if rec is None:
+        print(
+            f"{args.record}: no SLO record found (need a JSON line with "
+            "an 'slo' block — run `python bench.py --slo`)",
+            file=sys.stderr,
+        )
+        return 2
+    summary = summarize(rec)
+    if args.json:
+        json.dump(summary, sys.stdout, indent=2, sort_keys=True)
+        sys.stdout.write("\n")
+    else:
+        render(summary)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

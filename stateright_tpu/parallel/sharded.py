@@ -155,6 +155,7 @@ class ShardedTpuBfsChecker(Checker):
         sieve=None,
         sieve_slots_per_device=None,
         sieve_bloom_bits=None,
+        fleet=True,
     ):
         model = options.model
         if not isinstance(model, BatchableModel):
@@ -258,6 +259,19 @@ class ShardedTpuBfsChecker(Checker):
         self._sieve_bits = sieve_bloom_bits
         self._sieve_dev = None
         self._last_comms = None
+        self._last_comms_per = None
+        # Fleet skew forensics (telemetry/fleet.py): per-shard per-wave
+        # rows ride the existing out_specs=P("fp") pulls (five extra
+        # int32 scalars per shard per wave) and host tier walls are
+        # attributed per shard. Opt-out (`fleet=False`) — the fold is
+        # host-side numpy over n-length vectors and never feeds back
+        # into the search, so bit-identity holds either way.
+        self._fleet_on = bool(fleet)
+        self._fi = None
+        self._fleet_lock = threading.Lock()
+        self._fleet_probe_s = [0.0] * n
+        self._fleet_evict_s = [0.0] * n
+        self._fleet_evict_bytes = [0] * n
         self._visitor = options._visitor
         self._target_state_count: Optional[int] = options._target_state_count
         self._depth_cap = options._target_max_depth or _DEPTH_INF
@@ -338,6 +352,11 @@ class ShardedTpuBfsChecker(Checker):
                     instruments=self._si,
                     shard=d,
                     tracer=self._tracer,
+                    # Fault-attribution tag (utils/faults.py): lets a
+                    # chaos spec stall/kill exactly one shard's host
+                    # tier — the injected-straggler seam the fleet skew
+                    # forensics are tested against (tests/test_fleet.py).
+                    owner=f"shard-{d}",
                 )
                 for d in range(n)
             ]
@@ -539,6 +558,13 @@ class ShardedTpuBfsChecker(Checker):
         # (the unsieved wave ships the full width), so A/B runs compare
         # lanes/bytes like for like.
         self._ci = CommsInstruments("sharded_bfs", registry=self._registry)
+        if self._fleet_on:
+            from ..telemetry.fleet import FleetInstruments
+
+            self._fi = FleetInstruments(
+                "sharded_bfs", n, registry=self._registry,
+                hosts=jax.process_count(),
+            )
         # Wave-timeline attribution (opt-in, telemetry/attribution.py):
         # same engine and phase names as TpuBfsChecker, prefixed
         # ``sharded_bfs`` — results stay bit-identical (fences change
@@ -564,7 +590,8 @@ class ShardedTpuBfsChecker(Checker):
     # -- per-device kernels (inside shard_map) ----------------------------
 
     def _route_insert(self, table_loc, hi, lo, valid):
-        """Key exchange + sharded claim-insert; returns (table, fresh, overflow).
+        """Key exchange + sharded claim-insert; returns
+        (table, fresh, overflow, recv_uniq).
 
         ``hi/lo/valid`` are this device's local candidate keys (m lanes).
         ``fresh`` marks, per local lane, that *this* lane's key claimed a
@@ -588,11 +615,11 @@ class ShardedTpuBfsChecker(Checker):
         )
         group_start = jax.lax.cummax(jnp.where(is_start, lanes, 0))
         pos = lanes - group_start
-        table_loc, fresh, _ack, overflow = self._exchange_at(
+        table_loc, fresh, _ack, overflow, recv_uniq = self._exchange_at(
             table_loc, hi[lane_s], lo[lane_s], lane_s, okey_s, pos, m, m,
             want_ack=False,
         )
-        return table_loc, fresh, overflow
+        return table_loc, fresh, overflow, recv_uniq
 
     def _exchange_at(
         self, table_loc, hi_s, lo_s, lane_s, okey_s, pos, R, m,
@@ -603,7 +630,11 @@ class ShardedTpuBfsChecker(Checker):
         op for op). Inputs are the owner-sorted keys with within-group
         offsets; outputs are per ORIGINAL lane.
 
-        Returns ``(table, fresh, acked, overflow)``. ``acked``
+        Returns ``(table, fresh, acked, overflow, recv_uniq)``.
+        ``recv_uniq`` is the OWNER-side insert load: how many unique keys
+        arrived at THIS shard's table in the exchange (the fleet skew
+        ledger's hash-partition imbalance column — free, the dedup mask
+        already exists). ``acked``
         (``want_ack=True`` — the sieved path) marks lanes whose key is
         provably resident at its owner after this exchange: claimed fresh
         OR already found, but NOT probe-cap overflow. That is exactly the
@@ -649,6 +680,7 @@ class ShardedTpuBfsChecker(Checker):
             table_loc, shi, slo, uniq
         )
         overflow = pending.sum()
+        recv_uniq = uniq.sum(dtype=jnp.int32)
         if want_ack:
             # Pack (fresh, resident) into one uint8 so the reverse
             # exchange stays a single collective.
@@ -669,7 +701,7 @@ class ShardedTpuBfsChecker(Checker):
                 .at[src_slot.reshape(-1)]
                 .set(back, mode="drop")
             )
-            return table_loc, (fl & 1) != 0, (fl & 2) != 0, overflow
+            return table_loc, (fl & 1) != 0, (fl & 2) != 0, overflow, recv_uniq
         # Un-sort fresh flags back to received order, then reverse-exchange.
         fresh_r = (
             jnp.zeros((n * R,), bool).at[sidx].set(fresh_s).reshape(n, R)
@@ -682,7 +714,7 @@ class ShardedTpuBfsChecker(Checker):
             .at[src_slot.reshape(-1)]
             .set(fresh_back.reshape(-1), mode="drop")
         )
-        return table_loc, fresh, None, overflow
+        return table_loc, fresh, None, overflow, recv_uniq
 
     def _comm_rungs(self, m):
         """Ascending per-destination exchange widths for an ``m``-lane
@@ -744,7 +776,7 @@ class ShardedTpuBfsChecker(Checker):
         rungs = self._comm_rungs(m)
         if len(rungs) == 1:
             ridx = jnp.int32(0)
-            table_loc, fresh, ack, overflow = self._exchange_at(
+            table_loc, fresh, ack, overflow, recv_uniq = self._exchange_at(
                 table_loc, hi_s, lo_s, lane_s, okey_s, pos, m, m,
                 want_ack=True,
             )
@@ -761,7 +793,7 @@ class ShardedTpuBfsChecker(Checker):
                 )(R)
                 for R in rungs
             ]
-            table_loc, fresh, ack, overflow = jax.lax.switch(
+            table_loc, fresh, ack, overflow, recv_uniq = jax.lax.switch(
                 ridx, branches, table_loc, hi_s, lo_s, lane_s, okey_s, pos
             )
         # Receipts: only owner-acked lanes (see _exchange_at) enter the
@@ -788,11 +820,11 @@ class ShardedTpuBfsChecker(Checker):
                 ),
             ]
         )
-        return table_loc, fresh, overflow, cache, bloom, comms
+        return table_loc, fresh, overflow, cache, bloom, comms, recv_uniq
 
     def _insert_local(self, table, hi, lo, valid):
         """Standalone sharded insert (used to seed the initial states)."""
-        table_loc, fresh, overflow = self._route_insert(
+        table_loc, fresh, overflow, _recv = self._route_insert(
             table[0], hi, lo, valid
         )
         return {
@@ -817,6 +849,8 @@ class ShardedTpuBfsChecker(Checker):
         for k in ("generated", "n_new", "overflow", "max_depth"):
             wrapped[k] = out[k][None]
         wrapped["comms"] = out["comms"][None]
+        if self._fleet_on:
+            wrapped["fleet"] = out["fleet"][None]
         if self._sieve:
             wrapped["sieve_cache"] = out["sieve_cache"][None]
             wrapped["sieve_bloom"] = out["sieve_bloom"][None]
@@ -874,13 +908,13 @@ class ShardedTpuBfsChecker(Checker):
         _shi, _slo, sidx, uniq = _sort_dedup(khi, klo, cvalid_flat)
         route = jnp.zeros((B,), bool).at[sidx].set(uniq)
         if self._sieve:
-            table_loc, fresh, overflow, cache, bloom, comms = (
+            table_loc, fresh, overflow, cache, bloom, comms, recv_uniq = (
                 self._route_insert_sieved(
                     table_loc, khi, klo, route, cache, bloom
                 )
             )
         else:
-            table_loc, fresh, overflow = self._route_insert(
+            table_loc, fresh, overflow, recv_uniq = self._route_insert(
                 table_loc, khi, klo, route
             )
             # Uniform comms vector (layout as _route_insert_sieved's):
@@ -924,6 +958,20 @@ class ShardedTpuBfsChecker(Checker):
             "parent_lo": lo[parent_row] * (jnp.arange(B) < fresh.sum()),
             "comms": comms,
         }
+        if self._fleet_on:
+            # Per-shard skew vector (telemetry/fleet.py FLEET_DEVICE_COLS
+            # order); stacked per device by out_specs=P("fp") so the
+            # controller sees the (n, 5) mesh view every pull. Write-only
+            # telemetry — nothing reads it back into the search.
+            out["fleet"] = jnp.stack(
+                [
+                    eval_mask.sum(dtype=jnp.int32),
+                    generated,
+                    fresh.sum(dtype=jnp.int32),
+                    recv_uniq,
+                    overflow.astype(jnp.int32),
+                ]
+            )
         if self._sieve:
             out["sieve_cache"] = cache
             out["sieve_bloom"] = bloom
@@ -1185,6 +1233,11 @@ class ShardedTpuBfsChecker(Checker):
             # telemetry only — never feeds back into results.
             "comms_acc": jnp.zeros_like(out0["comms"]),
             "budget": budget0,
+            **(
+                {"fleet_acc": jnp.zeros_like(out0["fleet"])}
+                if self._fleet_on
+                else {}
+            ),
             # The pre-loop wave (out0) counts against the cap too, so a
             # drain runs at most max_drain_waves waves total (the cap backs
             # the checkpoint-durability guarantee).
@@ -1258,6 +1311,11 @@ class ShardedTpuBfsChecker(Checker):
                 "max_depth": jnp.maximum(c["max_depth"], o["max_depth"]),
                 "comms_acc": c["comms_acc"] + o["comms"],
                 "budget": budget,
+                **(
+                    {"fleet_acc": c["fleet_acc"] + o["fleet"]}
+                    if self._fleet_on
+                    else {}
+                ),
                 "waves": waves,
                 "go": self._drain_decide(
                     out, count, log_n, budget, waves, gen_acc, undiscovered
@@ -1301,6 +1359,8 @@ class ShardedTpuBfsChecker(Checker):
             # wave's — same accounting boundary as cov_acc below.
             "comms_acc": (res["comms_acc"] + o["comms"])[None],
         }
+        if self._fleet_on:
+            out["fleet_acc"] = (res["fleet_acc"] + o["fleet"])[None]
         if self._sieve:
             out["final"]["sieve_cache"] = o["sieve_cache"][None]
             out["final"]["sieve_bloom"] = o["sieve_bloom"][None]
@@ -1445,7 +1505,11 @@ class ShardedTpuBfsChecker(Checker):
                 )
             else:
                 for d, keys in enumerate(shard_keys):
+                    t0 = time.perf_counter()
                     self._tiers[d].evict(keys)
+                    self._fleet_note_evict(
+                        d, time.perf_counter() - t0, keys.nbytes
+                    )
             self._cap_loc = self._max_cap_loc
             self._l0_count = 0
             self._si.set_l0(0)
@@ -1512,18 +1576,35 @@ class ShardedTpuBfsChecker(Checker):
         """Pipeline-worker half of a deferred eviction (all shards)."""
         with self._phase_overlapped("evict"):
             for d, keys in enumerate(shard_keys):
+                t0 = time.perf_counter()
                 self._tiers[d].evict(keys)
+                self._fleet_note_evict(
+                    d, time.perf_counter() - t0, keys.nbytes
+                )
 
     def _probe_tiers(self, keys):
         """Union membership over every shard's store (L1 then L2 inside
         each; Bloom filters reject non-owner probes in O(1))."""
         found = np.zeros(len(keys), bool)
-        for t in self._tiers:
+        for d, t in enumerate(self._tiers):
             rem = np.flatnonzero(~found)
             if not len(rem):
                 break
+            t0 = time.perf_counter()
             found[rem] = t.probe(keys[rem])
+            if self._fleet_on:
+                with self._fleet_lock:
+                    self._fleet_probe_s[d] += time.perf_counter() - t0
         return found
+
+    def _fleet_note_evict(self, d, seconds, nbytes):
+        """Attributes one shard's tier-evict wall/bytes to the fleet
+        ledger (called from both the sync loop and the pipeline worker)."""
+        if not self._fleet_on:
+            return
+        with self._fleet_lock:
+            self._fleet_evict_s[d] += seconds
+            self._fleet_evict_bytes[d] += int(nbytes)
 
     def _pull(self, x):
         """A numpy view of a device array. Multi-controller: the array's
@@ -2075,9 +2156,14 @@ class ShardedTpuBfsChecker(Checker):
             # The sieve operands are donated: rebind before anything can
             # touch the stale references.
             self._sieve_dev = (out["sieve_cache"], out["sieve_bloom"])
-        self._consume_comms(
+        args = self._consume_comms(
             out["comms"], dev["hi"].shape[0] // self._n * self._A
         )
+        if self._fleet_on:
+            # Mutates the stashed span-args dict in place, so the async
+            # path's captured ``self._last_comms`` reference carries the
+            # fleet columns with no extra plumbing.
+            args.update(self._consume_fleet(out["fleet"]))
         return out
 
     def _consume_comms(self, comms, m):
@@ -2087,7 +2173,9 @@ class ShardedTpuBfsChecker(Checker):
         the per-device candidate-lane width, which fixes the rung
         ladder). Returns (and stashes) the span-args dict the wave span
         rides."""
-        c = np.asarray(self._pull(comms), np.int64).sum(axis=0)
+        per = np.asarray(self._pull(comms), np.int64)  # (n, vec)
+        self._last_comms_per = per
+        c = per.sum(axis=0)
         args = self._ci.record(
             probes=int(c[0]),
             killed=int(c[1]),
@@ -2103,6 +2191,39 @@ class ShardedTpuBfsChecker(Checker):
                 self._ci.rung_dispatch(width, cnt)
         self._last_comms = args
         return args
+
+    def _consume_fleet(self, fleet_dev, waves=1):
+        """Folds one dispatch's per-shard skew rows — device counters
+        (``_wave_core``'s ``fleet`` vector), the per-shard columns of the
+        comms exchange already pulled by ``_consume_comms``, and the host
+        tier walls accumulated per shard since the last fold — into the
+        ``fleet.*`` family. Returns the ``fleet_*`` span args."""
+        per = np.asarray(self._pull(fleet_dev), np.float64)
+        if per.ndim == 1:
+            per = per[None]
+        n = self._n
+        with self._fleet_lock:
+            probe_s = self._fleet_probe_s
+            evict_s = self._fleet_evict_s
+            evict_b = self._fleet_evict_bytes
+            self._fleet_probe_s = [0.0] * n
+            self._fleet_evict_s = [0.0] * n
+            self._fleet_evict_bytes = [0] * n
+        rows = {
+            "live_lanes": per[:, 0],
+            "generated": per[:, 1],
+            "fresh": per[:, 2],
+            "insert_load": per[:, 3],
+            "overflow": per[:, 4],
+            "probe_ms": np.asarray(probe_s) * 1e3,
+            "evict_ms": np.asarray(evict_s) * 1e3,
+            "evict_bytes": np.asarray(evict_b, np.float64),
+        }
+        cm = self._last_comms_per
+        if cm is not None and cm.shape[0] == n and cm.shape[1] >= 3:
+            rows["sieve_hits"] = cm[:, 1].astype(np.float64)
+            rows["routed"] = cm[:, 2].astype(np.float64)
+        return self._fi.record_wave(rows, waves=waves)
 
     # -- deep-drain host loop ---------------------------------------------
 
@@ -2261,6 +2382,13 @@ class ShardedTpuBfsChecker(Checker):
                     comms_extra = self._consume_comms(
                         res["comms_acc"], self._F_loc * self._A
                     )
+                    if self._fleet_on:
+                        comms_extra.update(
+                            self._consume_fleet(
+                                res["fleet_acc"],
+                                waves=int(dstats[:, 4].max()),
+                            )
+                        )
                     self._wi.record(
                         drain_span,
                         frontier=self._G,

@@ -122,6 +122,12 @@ class _ServiceHandler(BaseHTTPRequestHandler):
                 return
             if handle_monitor_get(self, self.core, self.path):
                 return
+            if self.path == "/slo":
+                # Per-mode SLO view (service/slo.py): rolling ttfv /
+                # verdict percentiles, queue/compile/explore ttfv
+                # decomposition, burn rates when targets are set.
+                _json_response(self, self.service.slo.snapshot())
+                return
             if self.path == "/jobs":
                 # Summary view: the UI polls this every ~2s; full
                 # verdicts (report text, ledgers) stay on /jobs/<id>.

@@ -173,6 +173,8 @@ class CheckService:
         service_dir: Optional[str] = None,
         stall_deadline_s: Optional[float] = None,
         on_stall: Optional[Callable] = None,
+        slo_targets: Optional[dict] = None,
+        max_run_registries: int = 64,
         clock=time.monotonic,
     ):
         self.quantum_s = float(quantum_s)
@@ -254,6 +256,19 @@ class CheckService:
         self._fault_class_counter = (
             lambda cls: reg.counter(f"fault.by_class.{cls}")
         )
+        # SLO ledger (service/slo.py): per-mode ttfv/verdict percentiles
+        # + queue/compile/explore decomposition, fed at the two verdict
+        # sites; ``slo_targets`` arms the burn-rate gauges.
+        from .slo import SLOLedger
+
+        self.slo = SLOLedger(targets=slo_targets, registry=reg)
+        # Registry retention, tighter than job retention: a RETAINED
+        # terminal job's run registry (hundreds of instruments) costs
+        # far more than its summary row, so registries beyond this cap
+        # are dropped oldest-first while the job records stay (their
+        # /jobs views keep working — results are snapshotted on the job).
+        self.max_run_registries = max(0, int(max_run_registries))
+        self._m_registry_evicted = reg.counter("service.registry_evicted")
         self._clock = clock
         self._admission_hold = False  # recover() gates scheduling
         self._cond = threading.Condition()
@@ -1363,6 +1378,7 @@ class CheckService:
         if job.retries:
             self._m_recovered.inc()
         job.complete(self._finalize(job, checker))
+        self.slo.observe(job)
         self._journal_state(job)
         self._drop_checkpoint(job.job_id)
 
@@ -1698,6 +1714,7 @@ class CheckService:
                     if job.retries:
                         self._m_recovered.inc()
                     job.complete(self._finalize(job, view))
+                    self.slo.observe(job)
                     self._journal_state(job)
                     self._drop_checkpoint(done_key)
                 for jid, job in members.items():
@@ -1715,9 +1732,15 @@ class CheckService:
 
     def _evict_finished(self) -> None:
         """Drops the oldest terminal jobs (and their run registries)
-        past the retention cap. Suspended/queued/running jobs are never
-        evicted."""
+        past the retention cap, and — the tighter bound — the run
+        registries of RETAINED terminal jobs past ``max_run_registries``
+        (LRU by finish time). A registry-evicted job keeps its record
+        and result (snapshotted on the job object); only its live
+        instrument registry is forgotten, counted by
+        ``service.registry_evicted``. Suspended/queued/running jobs are
+        never evicted."""
         from ..telemetry import discard_run_registry
+        from ..telemetry.metrics import run_registries
 
         with self._cond:
             finished = sorted(
@@ -1736,6 +1759,12 @@ class CheckService:
                 del self._jobs[j.job_id]
         for j in excess:
             discard_run_registry(j.run_id)
+        retained = finished[len(excess):]
+        live = run_registries()
+        with_reg = [j for j in retained if j.run_id in live]
+        for j in with_reg[: max(0, len(with_reg) - self.max_run_registries)]:
+            discard_run_registry(j.run_id)
+            self._m_registry_evicted.inc()
 
     def _finalize(self, job: CheckJob, checker) -> dict:
         """The completed job's verdict record (the bench's per-job row)."""

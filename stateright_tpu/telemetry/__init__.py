@@ -23,6 +23,7 @@ simulation, the TPU wave/drain loops, and the sharded mesh checker).
 
 from .attribution import WaveAttribution
 from .coverage import CoverageLedger, DeviceCoverage
+from .fleet import FleetFold, FleetInstruments, skew_stats
 from .instruments import (
     BlockInstruments,
     CommsInstruments,
@@ -84,6 +85,8 @@ __all__ = [
     "Counter",
     "CoverageLedger",
     "DeviceCoverage",
+    "FleetFold",
+    "FleetInstruments",
     "FlightRecorder",
     "Gauge",
     "Histogram",
@@ -110,6 +113,7 @@ __all__ = [
     "prometheus_text_all_runs",
     "registry_hygiene_problems",
     "run_registries",
+    "skew_stats",
     "span",
     "write_chrome_trace",
 ]

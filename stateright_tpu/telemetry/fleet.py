@@ -1,0 +1,352 @@
+"""Fleet observability: per-shard skew forensics for the sharded mesh.
+
+The sharded checker's controller already sees per-shard data every wave —
+``out_specs=P("fp")`` stacks one row per device, and the comms vector is
+pulled as an ``(n, k)`` array before it is summed. This module is the
+fold that stops throwing the per-shard axis away:
+
+- :class:`FleetFold` — the pure aggregator. Fed one dict of per-shard
+  columns per host-visible wave/drain (device counters, per-shard comms
+  columns, host-side tier timings), it keeps per-shard running totals,
+  per-wave skew (max/mean and coefficient of variation), and a
+  persistent-straggler detector (EWMA of each shard's per-wave cost
+  share, plus a slowest-wave tally) naming the top-k slowest shards.
+- :class:`FleetInstruments` — the fold wired to a ``fleet.*`` metric
+  family (per-shard gauges, skew gauges, straggler gauges) and to the
+  wave span: ``record_wave`` returns JSON-able ``fleet_*`` span args so
+  trace readers (``scripts/gap_report.py --fleet``) and the monitor's
+  ``/fleet`` view reconstruct the same fold from the trace alone.
+
+Everything here is host-side numpy over ``n_shards``-length vectors —
+the device kernels only stack a few extra int32 scalars per shard — and
+the bundle tracks its own fold cost (``fleet.overhead_seconds``) so the
+<5% overhead budget is measured, not asserted on faith. Results are
+never read back into the search: bit-identity is untouched by
+construction.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .metrics import MetricsRegistry, metrics_registry
+
+# Per-shard columns, in the order the fold reports them. The device
+# vector (parallel/sharded.py `_wave_core`) carries the first five; the
+# comms columns come from the per-shard exchange vector the controller
+# already pulls; the host columns are per-shard tier timings.
+FLEET_DEVICE_COLS = (
+    "live_lanes",    # eval_mask lanes this shard expanded
+    "generated",     # candidates generated on this shard
+    "fresh",         # claim-winning lanes this shard generated
+    "insert_load",   # unique keys RECEIVED at this shard (owner side)
+    "overflow",      # probe-cap overflow at this shard's table
+)
+FLEET_COMMS_COLS = (
+    "routed",        # candidate lanes entering this shard's router
+    "sieve_hits",    # lanes the receipt cache killed pre-exchange
+)
+FLEET_HOST_COLS = (
+    "probe_ms",      # host tier-probe wall attributed to this shard
+    "evict_ms",      # host tier-evict wall attributed to this shard
+    "evict_bytes",   # bytes this shard's table drained to its tier
+)
+FLEET_COLS = FLEET_DEVICE_COLS + FLEET_COMMS_COLS + FLEET_HOST_COLS
+
+# Columns whose skew is worth a gauge (counters with a meaningful
+# per-wave mesh mean). `overflow`/`evict_bytes` are episodic, not loads.
+SKEW_COLS = ("live_lanes", "fresh", "insert_load", "probe_ms")
+
+
+def skew_stats(values: Sequence[float]) -> Optional[Dict[str, float]]:
+    """max/mean and coefficient of variation of one per-shard vector;
+    None when the vector is empty or all-zero (no load, no skew)."""
+    v = np.asarray(values, np.float64)
+    if v.size == 0:
+        return None
+    mean = float(v.mean())
+    if mean <= 0.0:
+        return None
+    return {
+        "max_over_mean": float(v.max()) / mean,
+        "cv": float(v.std()) / mean,
+    }
+
+
+class FleetFold:
+    """The pure per-shard aggregator (no registry, no tracer — reusable
+    from the monitor's span sink and from trace post-processing).
+
+    ``consume`` takes one wave's ``{col: per-shard vector}`` dict;
+    missing columns read as zero. The straggler detector ranks shards by
+    an EWMA of their per-wave *cost share*, where a wave's cost vector
+    is the host tier wall when any shard paid one (time dominates) and
+    the owner-side insert load otherwise (the hash-partition imbalance
+    proxy) — falling back to live lanes for waves with neither."""
+
+    def __init__(self, n_shards: Optional[int] = None, hosts: int = 1,
+                 top_k: int = 2, ewma_alpha: float = 0.25):
+        self.n = n_shards
+        self.hosts = max(1, int(hosts))
+        self.top_k = max(1, int(top_k))
+        self.alpha = float(ewma_alpha)
+        self.waves = 0
+        self.cost_waves = 0  # waves that carried a nonzero cost vector
+        self.totals: Dict[str, np.ndarray] = {}
+        self.ewma_share: Optional[np.ndarray] = None
+        self.slowest: Optional[np.ndarray] = None
+        self.last_skew: Dict[str, Dict[str, float]] = {}
+
+    def _ensure(self, n: int) -> None:
+        if self.n is None:
+            self.n = n
+        if self.ewma_share is None:
+            self.totals = {
+                c: np.zeros(self.n, np.float64) for c in FLEET_COLS
+            }
+            self.ewma_share = np.full(self.n, 1.0 / self.n)
+            self.slowest = np.zeros(self.n, np.int64)
+
+    def _cost(self, rows: Dict[str, np.ndarray]) -> np.ndarray:
+        host = rows["probe_ms"] + rows["evict_ms"]
+        if host.sum() > 0.0:
+            return host
+        if rows["insert_load"].sum() > 0.0:
+            return rows["insert_load"]
+        return rows["live_lanes"]
+
+    def consume(self, rows: Dict[str, Sequence[float]],
+                waves: int = 1) -> Dict[str, object]:
+        """Folds one wave (or drain-aggregate: ``waves`` > 1) of
+        per-shard columns; returns the wave's skew/straggler block (the
+        live view the instruments publish)."""
+        n = max(len(v) for v in rows.values())
+        self._ensure(n)
+        full = {
+            c: np.asarray(
+                rows.get(c, np.zeros(self.n)), np.float64
+            )
+            for c in FLEET_COLS
+        }
+        self.waves += max(1, int(waves))
+        for c, v in full.items():
+            self.totals[c] += v
+        self.last_skew = {
+            c: s
+            for c in SKEW_COLS
+            if (s := skew_stats(full[c])) is not None
+        }
+        cost = self._cost(full)
+        total = float(cost.sum())
+        out: Dict[str, object] = {"skew": self.last_skew}
+        if total > 0.0:
+            share = cost / total
+            self.ewma_share = (
+                (1.0 - self.alpha) * self.ewma_share + self.alpha * share
+            )
+            self.slowest[int(cost.argmax())] += 1
+            self.cost_waves += 1
+            out["cost_skew"] = skew_stats(cost)
+        return out
+
+    def stragglers(self) -> List[Dict[str, float]]:
+        """The top-k slowest shards by EWMA cost share, slowest first.
+        ``score`` is the share normalized by the balanced share ``1/n``
+        (1.0 == perfectly balanced); ``persistence`` the fraction of
+        cost-bearing waves this shard was the single slowest."""
+        if self.ewma_share is None or not self.cost_waves:
+            return []
+        order = np.argsort(self.ewma_share)[::-1][: self.top_k]
+        return [
+            {
+                "shard": int(d),
+                "host": int(d) // max(1, self.n // self.hosts),
+                "score": float(self.ewma_share[d] * self.n),
+                "share": float(self.ewma_share[d]),
+                "persistence": float(self.slowest[d]) / self.cost_waves,
+                "slowest_waves": int(self.slowest[d]),
+            }
+            for d in order
+        ]
+
+    def summary(self) -> Dict[str, object]:
+        """The ``/fleet`` JSON: per-shard totals + skew + stragglers."""
+        if self.n is None or self.ewma_share is None:
+            return {"shards": 0, "waves": 0, "per_shard": []}
+        per_host = max(1, self.n // self.hosts)
+        per_shard = [
+            {
+                "shard": d,
+                "host": d // per_host,
+                **{c: float(self.totals[c][d]) for c in FLEET_COLS},
+                "cost_share_ewma": float(self.ewma_share[d]),
+            }
+            for d in range(self.n)
+        ]
+        skew_totals = {
+            c: s
+            for c in SKEW_COLS
+            if (s := skew_stats(self.totals[c])) is not None
+        }
+        return {
+            "shards": self.n,
+            "hosts": self.hosts,
+            "waves": self.waves,
+            "per_shard": per_shard,
+            "skew": skew_totals,
+            "skew_last_wave": self.last_skew,
+            "stragglers": self.stragglers(),
+        }
+
+    # -- span-args round trip (gap_report / MonitorCore) --------------------
+
+    @staticmethod
+    def span_args(rows: Dict[str, np.ndarray], shards: int,
+                  hosts: int) -> Dict[str, object]:
+        """One wave's fold input as JSON-able wave-span args (lists ride
+        span args like scalars do)."""
+        out: Dict[str, object] = {
+            "fleet_shards": int(shards), "fleet_hosts": int(hosts),
+        }
+        for c in FLEET_COLS:
+            v = rows.get(c)
+            if v is None:
+                continue
+            out[f"fleet_{c}"] = [round(float(x), 3) for x in v]
+        return out
+
+    def consume_span_args(self, args: Dict[str, object]) -> None:
+        """Feeds one wave span's ``fleet_*`` args back through the fold
+        (the monitor's sink path — same math as the in-checker fold)."""
+        shards = args.get("fleet_shards")
+        if not shards:
+            return
+        self.hosts = max(self.hosts, int(args.get("fleet_hosts") or 1))
+        rows = {
+            c: args[f"fleet_{c}"]
+            for c in FLEET_COLS
+            if isinstance(args.get(f"fleet_{c}"), (list, tuple))
+        }
+        if rows:
+            # Drain spans aggregate many device waves into one emission;
+            # the span's own `waves` arg keeps the fold's wave count
+            # honest (missing -> one host-visible wave).
+            try:
+                waves = max(1, int(args.get("waves") or 1))
+            except (TypeError, ValueError):
+                waves = 1
+            self.consume(rows, waves=waves)
+
+
+class FleetInstruments:
+    """The fold + the ``fleet.*`` metric family for one sharded run.
+
+    Per-shard gauges (``fleet.shard.<d>.<col>``, cumulative), skew
+    gauges (``fleet.skew.<col>.max_over_mean`` / ``.cv`` — last wave's,
+    plus the cost-vector pair under ``fleet.skew.cost.*``), straggler
+    gauges (``fleet.straggler.shard`` / ``.score`` / ``.persistence``),
+    a ``fleet.waves`` counter, and ``fleet.overhead_seconds`` — the
+    fold's own measured host cost, the number the opt-out budget test
+    holds against total wall."""
+
+    def __init__(self, prefix: str, n_shards: int,
+                 registry: MetricsRegistry = None, hosts: int = 1,
+                 top_k: int = 2):
+        reg = registry if registry is not None else metrics_registry()
+        self._registry = reg
+        self._prefix = prefix
+        self.fold = FleetFold(n_shards, hosts=hosts, top_k=top_k)
+        self.waves = reg.counter(f"{prefix}.fleet.waves")
+        self.overhead = reg.gauge(f"{prefix}.fleet.overhead_seconds")
+        self.overhead_s = 0.0
+        self._g_straggler = reg.gauge(f"{prefix}.fleet.straggler.shard")
+        self._g_score = reg.gauge(f"{prefix}.fleet.straggler.score")
+        self._g_persist = reg.gauge(f"{prefix}.fleet.straggler.persistence")
+        # Lazy per-shard / per-column gauges: only columns a run
+        # actually records exist in the registry.
+        self._shard_gauges: Dict[tuple, object] = {}
+        self._skew_gauges: Dict[tuple, object] = {}
+
+    def _shard_gauge(self, d: int, col: str):
+        g = self._shard_gauges.get((d, col))
+        if g is None:
+            g = self._registry.gauge(
+                f"{self._prefix}.fleet.shard.{d}.{col}"
+            )
+            self._shard_gauges[(d, col)] = g
+        return g
+
+    def _skew_gauge(self, col: str, stat: str):
+        g = self._skew_gauges.get((col, stat))
+        if g is None:
+            g = self._registry.gauge(
+                f"{self._prefix}.fleet.skew.{col}.{stat}"
+            )
+            self._skew_gauges[(col, stat)] = g
+        return g
+
+    def record_wave(self, rows: Dict[str, np.ndarray],
+                    waves: int = 1) -> Dict[str, object]:
+        """One host-visible wave's (or drain-aggregate's) per-shard
+        columns: fold + gauges; returns the ``fleet_*`` span args."""
+        t0 = time.perf_counter()
+        fold = self.fold
+        wave_view = fold.consume(rows, waves=waves)
+        self.waves.inc(max(1, int(waves)))
+        for d in range(fold.n):
+            for c in FLEET_COLS:
+                self._shard_gauge(d, c).set(float(fold.totals[c][d]))
+        for c, s in wave_view["skew"].items():
+            self._skew_gauge(c, "max_over_mean").set(s["max_over_mean"])
+            self._skew_gauge(c, "cv").set(s["cv"])
+        cost_skew = wave_view.get("cost_skew")
+        if cost_skew is not None:
+            self._skew_gauge("cost", "max_over_mean").set(
+                cost_skew["max_over_mean"]
+            )
+            self._skew_gauge("cost", "cv").set(cost_skew["cv"])
+        top = fold.stragglers()
+        if top:
+            self._g_straggler.set(top[0]["shard"])
+            self._g_score.set(top[0]["score"])
+            self._g_persist.set(top[0]["persistence"])
+        args = fold.span_args(rows, fold.n, fold.hosts)
+        self.overhead_s += time.perf_counter() - t0
+        self.overhead.set(self.overhead_s)
+        return args
+
+    def summary(self) -> Dict[str, object]:
+        out = self.fold.summary()
+        out["overhead_s"] = self.overhead_s
+        return out
+
+
+def fleet_prometheus_lines(fold: FleetFold,
+                           prefix: str = "stateright") -> List[str]:
+    """Per-shard series with ``shard``/``host`` labels for the
+    Prometheus exposition (the ``/fleet`` scrape view): one
+    ``<prefix>_fleet_<col>{shard=,host=}`` gauge line per shard per
+    recorded column, plus the straggler pair."""
+    if fold.n is None or fold.ewma_share is None:
+        return []
+    per_host = max(1, fold.n // fold.hosts)
+    lines: List[str] = []
+    for c in FLEET_COLS:
+        name = f"{prefix}_fleet_{c}"
+        lines.append(f"# TYPE {name} gauge")
+        for d in range(fold.n):
+            lines.append(
+                f'{name}{{shard="{d}",host="{d // per_host}"}} '
+                f"{float(fold.totals[c][d])!r}"
+            )
+    name = f"{prefix}_fleet_cost_share_ewma"
+    lines.append(f"# TYPE {name} gauge")
+    for d in range(fold.n):
+        lines.append(
+            f'{name}{{shard="{d}",host="{d // per_host}"}} '
+            f"{float(fold.ewma_share[d])!r}"
+        )
+    return lines

@@ -762,6 +762,16 @@ class MonitorCore:
         self._pipe_device_ms = 0.0
         self._pipe_host_ms = 0.0
         self._pipe_gap_ms = 0.0
+        # Fleet skew aggregation (telemetry/fleet.py): wave spans from a
+        # sharded checker carry per-shard ``fleet_*`` columns; the fold
+        # rebuilds the same skew/straggler view the in-checker
+        # instruments publish — which makes this core the ONE scrape
+        # target for a multi-process mesh (every controller emits
+        # identical rows, so any process's monitor serves the fleet).
+        from .fleet import FleetFold
+
+        self.fleet = FleetFold()
+        self._c_fleet = self.registry.counter("monitor.fleet.events")
         self.watchdog: Optional[StallWatchdog] = None
         if stall_deadline_s is not None:
             self.watchdog = StallWatchdog(
@@ -790,6 +800,14 @@ class MonitorCore:
             and args.get("run_id") != self.run_filter
         ):
             return
+        if "fleet_shards" in args:
+            self.fleet.consume_span_args(args)
+            self._c_fleet.inc()
+            self.broker.publish("fleet", {
+                "name": name,
+                "skew": self.fleet.last_skew,
+                "stragglers": self.fleet.stragglers(),
+            })
         if "new_unique" in args:
             # Span `frontier` is the DISPATCH width (drains: F_max / G,
             # waves: the padded chunk width) — constant-ish all run. The
@@ -982,8 +1000,24 @@ class MonitorCore:
         out["metrics"] = self.registry.snapshot()
         return out
 
+    def fleet_view(self) -> Dict[str, object]:
+        """The ``/fleet`` JSON: merged per-shard totals, per-wave skew,
+        and the persistent-straggler ranking (empty-shaped when no
+        sharded run has emitted fleet columns yet)."""
+        out = self.fleet.summary()
+        out["run_id"] = self.run_id
+        return out
+
     def prometheus(self) -> str:
-        return prometheus_text(self.registry)
+        text = prometheus_text(self.registry)
+        # Per-shard fleet series with shard/host labels — the exposition
+        # a mesh-wide scrape joins on, next to the unlabeled families.
+        from .fleet import fleet_prometheus_lines
+
+        lines = fleet_prometheus_lines(self.fleet)
+        if lines:
+            text = text + "\n".join(lines) + "\n"
+        return text
 
     def close(self) -> None:
         self.closing.set()
@@ -1007,7 +1041,7 @@ def _send(handler: BaseHTTPRequestHandler, body: bytes,
 
 def handle_monitor_get(handler: BaseHTTPRequestHandler, core: MonitorCore,
                        path: str) -> bool:
-    """Routes ``/metrics``, ``/status``, ``/events`` on any
+    """Routes ``/metrics``, ``/status``, ``/events``, ``/fleet`` on any
     BaseHTTPRequestHandler; returns False when the path is not ours so
     the caller's own routing continues (the Explorer mounts these next
     to ``/.status``/``/.states``)."""
@@ -1023,6 +1057,13 @@ def handle_monitor_get(handler: BaseHTTPRequestHandler, core: MonitorCore,
         _send(
             handler,
             json.dumps(core.status(), default=str).encode(),
+            "application/json",
+        )
+        return True
+    if path == "/fleet":
+        _send(
+            handler,
+            json.dumps(core.fleet_view(), default=str).encode(),
             "application/json",
         )
         return True
@@ -1090,7 +1131,9 @@ class _MonitorHandler(BaseHTTPRequestHandler):
             if self.path in ("/", ""):
                 body = json.dumps({
                     "run_id": self.core.run_id,
-                    "endpoints": ["/metrics", "/status", "/events"],
+                    "endpoints": [
+                        "/metrics", "/status", "/events", "/fleet",
+                    ],
                 }).encode()
                 _send(self, body, "application/json")
                 return

@@ -314,6 +314,107 @@ function renderCoverageBars(m) {
     .join("");
 }
 
+// ---- fleet skew panel -----------------------------------------------------
+// Per-shard load bars + skew stats + the straggler call from /fleet
+// (telemetry/server.py fleet_view). Refreshes are driven by the SSE
+// "fleet" events the monitor publishes per folded wave, throttled like
+// /status. Panel stays hidden on single-device runs (no fleet rows).
+
+const fleet = { lastFetch: 0 };
+
+async function refreshFleet() {
+  const now = Date.now();
+  if (now - fleet.lastFetch < 1500) return;
+  fleet.lastFetch = now;
+  try {
+    const f = await getJSON("/fleet");
+    const rows = f.per_shard || [];
+    if (!rows.length) return;
+    $("fleet-panel").classList.remove("hidden");
+    // Bar per shard on the straggler detector's cost basis: host tier
+    // walls when any shard paid one, owner-side insert load otherwise.
+    const anyHost = rows.some((r) => (r.probe_ms || 0) + (r.evict_ms || 0) > 0);
+    const cost = (r) =>
+      anyHost ? (r.probe_ms || 0) + (r.evict_ms || 0) : r.insert_load || 0;
+    const peak = Math.max(...rows.map(cost), 1e-9);
+    const worst = (f.stragglers || [])[0];
+    $("fleet-bars").innerHTML = rows
+      .map((r) => {
+        const c = cost(r);
+        const w = Math.max(1, Math.round((100 * c) / peak));
+        const straggling = worst && worst.shard === r.shard;
+        const label = `s${r.shard}` + (f.hosts > 1 ? `/h${r.host}` : "");
+        return (
+          `<div class="covrow${straggling ? " dead" : ""}" ` +
+          `title="live=${fmtNum(r.live_lanes)} fresh=${fmtNum(r.fresh)} ` +
+          `insert=${fmtNum(r.insert_load)} probe=${fmtNum(r.probe_ms)}ms">` +
+          `<span class="covlabel">${esc(label)}</span>` +
+          `<span class="covbar"><span class="fired" style="width:${w}%"></span></span>` +
+          `<span class="covnum">${straggling ? "SLOW" : fmtNum(c)}</span></div>`
+        );
+      })
+      .join("");
+    const skew = f.skew || {};
+    const parts = Object.keys(skew)
+      .sort()
+      .map((c) => `${esc(c)} ×${skew[c].max_over_mean.toFixed(2)}`);
+    let text = parts.length ? `skew (max/mean): ${parts.join(", ")}` : "";
+    if (worst && worst.persistence > 0)
+      text +=
+        `${text ? " — " : ""}straggler: shard ${worst.shard}` +
+        ` (slowest ${(100 * worst.persistence).toFixed(0)}% of waves)`;
+    $("fleet-skew").textContent = text;
+  } catch (err) {
+    // /fleet absent (older server) or mid-teardown; panel stays as-is
+  }
+}
+
+// ---- job SLO panel --------------------------------------------------------
+// Per-mode rolling latency objectives from the service's /slo endpoint
+// (service/slo.py snapshot): ttfv p50/p99, the queue/compile/explore
+// decomposition, burn rate vs targets. Probed once like /jobs — an
+// Explorer-only serve never 404-polls for a hidden panel.
+
+async function refreshSlo() {
+  const s = await getJSON("/slo");
+  const modes = s.modes || {};
+  const rows = Object.keys(modes)
+    .filter((m) => (modes[m].jobs || 0) > 0)
+    .map((m) => {
+      const v = modes[m];
+      const d = v.decomposition || {};
+      const p50 = (block) =>
+        block && block.p50_s != null ? fmtSecs(block.p50_s) : "–";
+      const burn = v.burn_rate
+        ? Object.keys(v.burn_rate)
+            .sort()
+            .map((k) => `${esc(k)} ${v.burn_rate[k].toFixed(1)}×`)
+            .join(", ")
+        : "–";
+      const hot = v.burn_rate &&
+        Object.values(v.burn_rate).some((b) => b > 1.0);
+      return (
+        `<tr class="${hot ? "job-failed" : ""}">` +
+        `<td>${esc(m)}</td><td>${v.jobs}</td>` +
+        `<td>${p50(v.ttfv)}</td>` +
+        `<td>${v.ttfv.p99_s != null ? fmtSecs(v.ttfv.p99_s) : "–"}</td>` +
+        `<td>${p50(d.queue_s)}</td><td>${p50(d.compile_s)}</td>` +
+        `<td>${p50(d.explore_s)}</td><td>${burn}</td></tr>`
+      );
+    });
+  $("slo-rows").innerHTML = rows.join("");
+  if (rows.length) $("slo-panel").classList.remove("hidden");
+}
+
+async function startSlo() {
+  try {
+    await refreshSlo();
+  } catch (err) {
+    return; // no /slo on this server: panel stays hidden
+  }
+  setInterval(() => refreshSlo().catch(() => {}), 2000);
+}
+
 function startMonitor() {
   let es;
   try {
@@ -339,6 +440,7 @@ function startMonitor() {
       $("mon-util").textContent = (100 * d.utilization).toFixed(1) + "%";
   });
   es.addEventListener("coverage", () => refreshMonitorStatus());
+  es.addEventListener("fleet", () => refreshFleet());
   es.onerror = () => {
     // Never connected => no monitor endpoints on this server: close for
     // good, panel stays hidden. Once live, errors are transient drops —
@@ -400,3 +502,4 @@ refreshStatus();
 setInterval(refreshStatus, 1000);
 startMonitor();
 startJobs();
+startSlo();

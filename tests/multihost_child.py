@@ -134,6 +134,17 @@ if mode == "sieve":
     kw["sieve"] = True
 model, expected = TwoPhaseSys(3), 288
 
+# Fleet observability across a REAL process boundary (plain leg, pid 0
+# only): a live monitor taps the default tracer before the run, and its
+# /fleet view must carry one row per shard of the JOINT mesh — 8 rows,
+# 4 of them owned by the OTHER controller (the per-shard columns ride
+# the same allgather as the comms exchange, so both hosts see all 8).
+monitor = None
+if pid == 0 and mode == "plain":
+    from stateright_tpu.telemetry.server import MonitorServer
+
+    monitor = MonitorServer(port=0)
+
 checker = model.checker().spawn_sharded_tpu_bfs(mesh=mesh, **kw).join()
 err = checker.worker_error()
 assert err is None, err
@@ -148,3 +159,19 @@ print(
     f"lanes={lanes}",
     flush=True,
 )
+
+if monitor is not None:
+    import json
+    from urllib.request import urlopen
+
+    with urlopen(f"{monitor.url}/fleet", timeout=10) as r:
+        fleet = json.load(r)
+    per_shard = fleet.get("per_shard") or []
+    assert len(per_shard) == 8, fleet
+    assert fleet.get("hosts") == 2, fleet
+    # Remote shards (4..7 live on pid 1) must carry real load, proving
+    # the rows crossed the process boundary rather than zero-filling.
+    assert all(row.get("insert_load", 0) > 0 for row in per_shard), per_shard
+    assert len(fleet.get("stragglers") or []) >= 1, fleet
+    monitor.close()
+    print(f"FLEET-OK pid={pid} shards={len(per_shard)}", flush=True)

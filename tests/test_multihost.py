@@ -73,6 +73,13 @@ def _run_pair(mode, timeout=390):
         assert m, out[-3000:]
         fields.append(tuple(int(g) for g in m.groups()))
     assert fields[0] == fields[1], f"controllers disagree: {fields}"
+    if mode == "plain":
+        # Fleet observability across the process boundary (ISSUE 18):
+        # pid 0 serves a live monitor during the run and asserts its
+        # /fleet view carries all 8 per-shard rows (4 owned by pid 1)
+        # with real load, printing this line only on success.
+        assert re.search(r"FLEET-OK pid=0 shards=8", outs[0]), \
+            outs[0][-3000:]
     return fields[0], wall
 
 
