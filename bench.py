@@ -920,6 +920,13 @@ def _run_service_leg(pin_cpu: bool, packed: bool = False):
     out["batch_rate"] = expected / max(wall - warm, 1e-9)
     log(f"[service] batch: {expected} unique, {out['batch_rate']:,.0f}/s")
 
+    # No service_dir here: the concurrent phase measures time-sliced
+    # throughput with purely in-memory AOT sharing (the r10-comparable
+    # metric), and a persistent dir would let later jobs reseed from
+    # the single-job phase's finished run. Disk-plane accounting —
+    # per-job aot_cache.* counters — lives in the warm-start sub-leg
+    # below; per_job rows here record "aot": null, which readers take
+    # as "no disk store attached".
     svc = CheckService(
         quantum_s=quantum, default_spawn=spawn,
         packing=packed, max_pack_tenants=max(8, jobs_n),
@@ -1024,6 +1031,12 @@ def _run_service_leg(pin_cpu: bool, packed: bool = False):
                     "mode": st.get("mode", "exhaustive"),
                     "rate": r["rate"],
                     "compile_s": compile_s,
+                    # Warm-start evidence (ISSUE 19): per-job disk-AOT
+                    # counters (absent without a service_dir) and the
+                    # seeded flag — report readers distinguish disk hits
+                    # from in-memory hits by these.
+                    "warm_start": bool(st.get("warm_start")),
+                    "aot": r.get("aot"),
                 }
             )
         out["aggregate_states_per_s"] = total_unique / wall
@@ -1088,6 +1101,177 @@ def _run_service_leg(pin_cpu: bool, packed: bool = False):
         out["slo"] = svc.slo.snapshot()
     finally:
         svc.close()
+
+    # 4. Warm-start sub-leg (ISSUE 19): the same shape served from a
+    # persistent service_dir across an emulated process restart, with
+    # the two planes measured SEPARATELY. The executable plane uses
+    # ``target_max_depth`` jobs (a target beyond 2pc's true depth, so
+    # the space is explored in full but the job stays out of the seed
+    # plane): cold-cold compiles and writes the disk AOT store, a
+    # resident resubmit gives the warm ttfv, and the post-restart run
+    # must be served compile-free off disk — that cold-vs-warm pair is
+    # the headline ``cold_over_warm_pct``. The seed plane uses plain
+    # full runs: the first writes the finished-run seed, the
+    # post-restart resubmit must reseed (O(verify), near-zero explore).
+    # True cross-process isolation is covered by tests/test_warmstart.py
+    # and the tier-1 smoke; the bench emulates the restart in-process
+    # (clear_shared_aot_caches) so one child carries the whole record.
+    # CPU-advisory like every latency number here.
+    if not packed:
+        import shutil
+        import tempfile
+
+        from stateright_tpu.checker.tpu import clear_shared_aot_caches
+
+        wdir = tempfile.mkdtemp(prefix="bench-warmstart-")
+
+        # The sub-leg must measure THIS repo's disk-AOT plane, so jax's
+        # own persistent compilation cache is repinned to a fresh temp
+        # dir for its duration: executables XLA loads from a warm box
+        # cache don't round-trip through serialize_executable ("Symbols
+        # not found" on this jax line), so a warm box cache would turn
+        # every sub-leg save into an honest save_refused and the
+        # cold-process run into a recompile — measuring the box, not
+        # the store.
+        def _repin_xla_cache(path):
+            try:
+                from jax._src import compilation_cache as _cc
+
+                _cc.reset_cache()
+            except Exception:
+                pass
+            try:
+                jax.config.update("jax_compilation_cache_dir", path)
+            except Exception:
+                pass
+
+        xla_prev = jax.config.jax_compilation_cache_dir
+        xla_tmp = tempfile.mkdtemp(prefix="bench-warmstart-xla-")
+        clear_shared_aot_caches()  # drop exes compiled under the old cache
+        _repin_xla_cache(xla_tmp)
+
+        def _one(svc_ws, target=False):
+            h = svc_ws.submit(
+                model_name="2pc", model_args={"rm_count": rm},
+                options={"target_max_depth": 64} if target else None,
+            )
+            r = h.result(timeout=SERVICE_LEG_TIMEOUT_S / 2)
+            st = h.status()
+            lat = st["latency"]
+            return {
+                "ttfv_s": lat["ttfv_s"],
+                "wall_s": lat["wall_s"],
+                "warmup_s": r["warmup_s"],
+                "warm_start": bool(st.get("warm_start")),
+                "aot": r.get("aot"),
+                "unique": r["unique"],
+            }
+
+        try:
+            svc_ws = CheckService(
+                quantum_s=quantum, default_spawn=spawn,
+                packing=False, service_dir=wdir,
+            )
+            reps = 3  # medians: single-shot ttfv is noise-dominated
+            try:
+                first = _one(svc_ws, target=True)   # cold-cold: compiles
+                warm_rows = [
+                    _one(svc_ws, target=True) for _ in range(reps)
+                ]  # resident warm resubmits
+                _one(svc_ws)  # plain full run: writes the seed
+            finally:
+                svc_ws.close()
+            cold_rows, pool_waits = [], []
+            pool_aot = {}
+            reseed_row = None
+            for i in range(reps):
+                clear_shared_aot_caches()  # emulate a process restart
+                # The intended cold-process flow: the warm pool
+                # pre-loads this shape's executables at service start
+                # (from the disk AOT store when present — no compile),
+                # so the first real job pays neither compile nor
+                # deserialize. The wait-to-ready is recorded.
+                t_pool = time.time()
+                svc_ws = CheckService(
+                    quantum_s=quantum, default_spawn=spawn,
+                    packing=False, service_dir=wdir,
+                    warm_pool=[("2pc", {"rm_count": rm})],
+                )
+                try:
+                    deadline = time.time() + 120.0
+                    while time.time() < deadline and any(
+                        e["state"] == "pending"
+                        for e in svc_ws.warm_pool_status.values()
+                    ):
+                        time.sleep(0.05)
+                    pool_waits.append(time.time() - t_pool)
+                    if i == 0:
+                        # Disk-plane evidence lives in the POOL job's
+                        # registry (it did the load); the measured job
+                        # then finds everything warm in memory.
+                        from stateright_tpu.telemetry import (
+                            metrics_registry as _mreg,
+                        )
+
+                        pool_jid = next(
+                            (e.get("job_id")
+                             for e in svc_ws.warm_pool_status.values()),
+                            None,
+                        )
+                        pool_aot = {
+                            k: v
+                            for k, v in (_mreg(pool_jid).snapshot()
+                                         if pool_jid else {}).items()
+                            if k.startswith("aot_cache.")
+                        }
+                    cold_rows.append(_one(svc_ws, target=True))
+                    if i == reps - 1:
+                        reseed_row = _one(svc_ws)  # seeded resubmission
+                finally:
+                    svc_ws.close()
+            warm_row, cold_row = warm_rows[0], cold_rows[0]
+            warm_ttfv = _pct(
+                [r["ttfv_s"] or r["wall_s"] for r in warm_rows], 50
+            )
+            cold_ttfv = _pct(
+                [r["ttfv_s"] or r["wall_s"] for r in cold_rows], 50
+            )
+            out["warmstart"] = {
+                "process_emulated": True,
+                "first_ttfv_s": first["ttfv_s"] or first["wall_s"],
+                "first_warmup_s": first["warmup_s"],
+                "warm_ttfv_s": warm_ttfv,
+                "cold_ttfv_s": cold_ttfv,
+                "cold_over_warm_pct": (
+                    100.0 * (cold_ttfv / warm_ttfv - 1.0)
+                    if warm_ttfv and cold_ttfv is not None
+                    else None
+                ),
+                "cold_warmup_s": cold_row["warmup_s"],
+                "cold_pool_wait_s": _pct(pool_waits, 50),
+                "cold_pool_aot": pool_aot,
+                "cold_aot": cold_row["aot"],
+                # Seed plane: the post-restart plain resubmit.
+                "seeded": reseed_row["warm_start"],
+                "seeded_ttfv_s": (
+                    reseed_row["ttfv_s"] or reseed_row["wall_s"]
+                ),
+                "cpu_advisory": device.platform == "cpu",
+            }
+            log(
+                f"[service] warm-start: first={out['warmstart']['first_ttfv_s']:.2f}s "
+                f"warm={warm_ttfv:.3f}s cold-process={cold_ttfv:.3f}s "
+                f"({out['warmstart']['cold_over_warm_pct']:+.1f}%, pool "
+                f"disk hits {pool_aot.get('aot_cache.disk_hit', 0)}, "
+                f"pool wait {out['warmstart']['cold_pool_wait_s']:.2f}s, "
+                f"cold warmup={cold_row['warmup_s']:.2f}s); reseed "
+                f"{out['warmstart']['seeded_ttfv_s']:.3f}s "
+                f"(seeded={reseed_row['warm_start']})"
+            )
+        finally:
+            _repin_xla_cache(xla_prev)
+            shutil.rmtree(wdir, ignore_errors=True)
+            shutil.rmtree(xla_tmp, ignore_errors=True)
     print(json.dumps(out))
 
 
@@ -2534,6 +2718,19 @@ def _main_service(packed: bool = False):
         "unit": "unique states/sec",
         **rec,
     }
+    # ``--service-out PATH`` persists the record as a BENCH_r* file
+    # (one JSON line, like --slo-out) so the warm-start sub-leg's
+    # cold-vs-warm figures land in the trajectory.
+    out_path = None
+    for i, arg in enumerate(sys.argv):
+        if arg == "--service-out" and i + 1 < len(sys.argv):
+            out_path = sys.argv[i + 1]
+        elif arg.startswith("--service-out="):
+            out_path = arg.split("=", 1)[1]
+    if out_path is not None:
+        with open(out_path, "w") as f:
+            f.write(json.dumps(line) + "\n")
+        log(f"[{label}] record written to {out_path}")
     print(json.dumps(line))
 
 

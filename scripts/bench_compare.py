@@ -706,6 +706,7 @@ def slo_trajectory(paths, out=sys.stdout):
                 rows.append((name, None, "(empty slo ledger)"))
                 continue
             d = view.get("decomposition") or {}
+            comp = view.get("compile") or {}
             rows.append((name, {
                 "source": "slo ledger",
                 "jobs": sum(v.get("jobs", 0) for v in modes.values()),
@@ -714,6 +715,12 @@ def slo_trajectory(paths, out=sys.stdout):
                 "queue": (d.get("queue_s") or {}).get("p50_s"),
                 "compile": (d.get("compile_s") or {}).get("p50_s"),
                 "explore": (d.get("explore_s") or {}).get("p50_s"),
+                # Compile-share columns (r19 warm-start records): the
+                # per-job compile-seconds percentiles and the fraction
+                # of served jobs that never compiled; None on r18.
+                "comp_p50": comp.get("p50_s"),
+                "comp_p99": comp.get("p99_s"),
+                "comp_free": comp.get("free_fraction"),
             }, None))
             newest_slo = (name, slo)
         elif "p50_ttfv_s" in rec:
@@ -804,6 +811,34 @@ def slo_trajectory(paths, out=sys.stdout):
             f"{cell((d.get('explore_s') or {}).get('p50_s')):>8} "
             f"{burn_cell:>12}\n"
         )
+    # Compile-share delta between the two newest SLO-ledger records
+    # (r18 -> r19): what the warm-start plane bought in per-job compile
+    # seconds and compile-free-job fraction.
+    ledger_rows = [
+        (n, r) for n, r, _ in rows
+        if r is not None and r.get("source") == "slo ledger"
+    ]
+    if len(ledger_rows) >= 2:
+        (old_name, old), (new_name, new) = ledger_rows[-2], ledger_rows[-1]
+        out.write(f"\ncompile share ({old_name} -> {new_name})\n")
+
+        def pct_cell(v):
+            return "-" if v is None else f"{v:.0%}"
+
+        for label, key, fmt in (
+            ("compile p50 (s)", "comp_p50", cell),
+            ("compile p99 (s)", "comp_p99", cell),
+            ("compile-free jobs", "comp_free", pct_cell),
+        ):
+            ov, nv = old.get(key), new.get(key)
+            delta = ""
+            if ov is not None and nv is not None and key != "comp_free":
+                delta = f"  ({nv - ov:+.3f}s)"
+            elif ov is not None and nv is not None:
+                delta = f"  ({(nv - ov) * 100:+.0f}pp)"
+            out.write(
+                f"  {label:<18} {fmt(ov):>9} -> {fmt(nv):>9}{delta}\n"
+            )
     return 0
 
 

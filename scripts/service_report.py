@@ -107,6 +107,26 @@ def summarize(rec):
                 j.get("mode", "exhaustive") == mode for j in per_job
             )
         },
+        # Warm-start plane (BENCH_r19+): seeded jobs, disk-AOT hit
+        # evidence, and the warm/cold ttfv sub-leg; absent on older
+        # records.
+        "jobs_warm_started": sum(
+            1 for j in per_job if j.get("warm_start")
+        ),
+        "aot_disk_hits": sum(
+            (j.get("aot") or {}).get("aot_cache.disk_hit", 0)
+            for j in per_job
+        ),
+        "aot_disk_misses": sum(
+            (j.get("aot") or {}).get("aot_cache.disk_miss", 0)
+            for j in per_job
+        ),
+        "aot_refused_stale": sum(
+            (j.get("aot") or {}).get("aot_cache.refused_stale", 0)
+            + (j.get("aot") or {}).get("aot_cache.refused_corrupt", 0)
+            for j in per_job
+        ),
+        "warmstart": rec.get("warmstart"),
         "per_job": per_job,
         # SLO ledger (BENCH_r18+ / any record carrying a GET /slo
         # snapshot): rendered as its own table; absent on older records.
@@ -168,6 +188,29 @@ def render(summary, out=sys.stdout):
         f"{summary['retries_total'] or 0} retries, "
         f"{summary['jobs_quarantined']} quarantined\n"
     )
+    if (
+        summary.get("jobs_warm_started")
+        or summary.get("aot_disk_hits")
+        or summary.get("aot_disk_misses")
+    ):
+        refused = summary.get("aot_refused_stale") or 0
+        w(
+            f"  warm start: {summary.get('jobs_warm_started', 0)}/"
+            f"{summary['jobs']} jobs seeded; disk AOT "
+            f"{summary.get('aot_disk_hits', 0)} hits / "
+            f"{summary.get('aot_disk_misses', 0)} misses"
+            + (f", {refused} refused (stale/corrupt)" if refused else "")
+            + "\n"
+        )
+    ws = summary.get("warmstart")
+    if ws:
+        w(
+            f"  warm vs cold process: warm ttfv "
+            f"{_fmt(ws.get('warm_ttfv_s'), '{:.3f}')}s, cold ttfv "
+            f"{_fmt(ws.get('cold_ttfv_s'), '{:.3f}')}s "
+            f"({_fmt(ws.get('cold_over_warm_pct'), '{:+.1f}')}% cold "
+            "over warm)\n"
+        )
     vmodes = summary.get("modes") or {}
     if len(vmodes) > 1 or "swarm" in vmodes:
         w(
@@ -188,11 +231,18 @@ def render(summary, out=sys.stdout):
     header = (
         f"  {'job':<10} {'tenant':<10} {'ttfv_s':>8} {'wall_s':>8} "
         f"{'queued_s':>9} {'rate':>10} {'preempts':>8} {'slices':>6} "
-        f"{'packed':>6} {'faults':>6} {'retries':>7} {'compile_s':>9}\n"
+        f"{'packed':>6} {'faults':>6} {'retries':>7} {'compile_s':>9} "
+        f"{'warm':>5}\n"
     )
     w(header)
     w("  " + "-" * (len(header) - 3) + "\n")
     for j in summary["per_job"]:
+        aot = j.get("aot") or {}
+        warm = (
+            "seed"
+            if j.get("warm_start")
+            else ("disk" if aot.get("aot_cache.disk_hit") else "-")
+        )
         w(
             f"  {j.get('job_id', '?'):<10} {str(j.get('tenant', '')):<10} "
             f"{_fmt(j.get('ttfv_s'), '{:.3f}'):>8} "
@@ -202,7 +252,8 @@ def render(summary, out=sys.stdout):
             f"{j.get('preempts', 0):>8} {j.get('slices', 0):>6} "
             f"{str(bool(j.get('packed', False))):>6} "
             f"{j.get('faults', 0):>6} {j.get('retries', 0):>7} "
-            f"{_fmt(j.get('compile_s'), '{:.2f}'):>9}\n"
+            f"{_fmt(j.get('compile_s'), '{:.2f}'):>9} "
+            f"{warm:>5}\n"
         )
 
 
@@ -229,19 +280,23 @@ def print_slo(slo, out=sys.stdout):
     w(f"\n  slo ledger{tgt}\n")
     header = (
         f"  {'mode':<12} {'jobs':>5} {'ttfv p50':>9} {'ttfv p99':>9} "
-        f"{'queue p50':>10} {'compile p50':>12} {'explore p50':>12}\n"
+        f"{'queue p50':>10} {'compile p50':>12} {'compile p99':>12} "
+        f"{'explore p50':>12} {'compile-free':>13}\n"
     )
     w(header)
     w("  " + "-" * (len(header) - 3) + "\n")
     for mode, view in modes.items():
         d = view.get("decomposition") or {}
+        comp = view.get("compile") or {}
         w(
             f"  {mode:<12} {view.get('jobs', 0):>5} "
             f"{_fmt(view['ttfv'].get('p50_s'), '{:.3f}'):>9} "
             f"{_fmt(view['ttfv'].get('p99_s'), '{:.3f}'):>9} "
             f"{_fmt((d.get('queue_s') or {}).get('p50_s'), '{:.3f}'):>10} "
-            f"{_fmt((d.get('compile_s') or {}).get('p50_s'), '{:.3f}'):>12} "
-            f"{_fmt((d.get('explore_s') or {}).get('p50_s'), '{:.3f}'):>12}\n"
+            f"{_fmt(comp.get('p50_s'), '{:.3f}'):>12} "
+            f"{_fmt(comp.get('p99_s'), '{:.3f}'):>12} "
+            f"{_fmt((d.get('explore_s') or {}).get('p50_s'), '{:.3f}'):>12} "
+            f"{_fmt(comp.get('free_fraction'), '{:.0%}'):>13}\n"
         )
         burn = view.get("burn_rate")
         if burn:

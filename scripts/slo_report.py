@@ -117,6 +117,31 @@ def render(summary, out=sys.stdout):
             f"{_fmt(view['verdict'].get('p99_s')):>12}\n"
         )
     w("\n")
+    # Compile share (warm-start plane): per-job compile seconds and the
+    # fraction of served jobs that never compiled at all.
+    any_compile = any(
+        (view.get("compile") or {}).get("count")
+        for view in summary["modes"].values()
+    )
+    if any_compile:
+        header = (
+            f"  {'mode':<12} {'compile p50':>12} {'compile p99':>12} "
+            f"{'compile-free':>13} {'warm-start':>11}\n"
+        )
+        w(header)
+        w("  " + "-" * (len(header) - 3) + "\n")
+        for mode, view in summary["modes"].items():
+            comp = view.get("compile") or {}
+            if not comp.get("count"):
+                continue
+            w(
+                f"  {mode:<12} "
+                f"{_fmt(comp.get('p50_s')):>12} "
+                f"{_fmt(comp.get('p99_s')):>12} "
+                f"{_fmt(comp.get('free_fraction'), '{:.0%}'):>13} "
+                f"{comp.get('warm_start_jobs', 0):>11}\n"
+            )
+        w("\n")
     any_burn = False
     for mode, view in summary["modes"].items():
         burn = view.get("burn_rate")

@@ -551,10 +551,12 @@ class TpuBfsChecker(Checker):
         coverage=False,
         run_id=None,
         aot_cache=None,
+        aot_store=None,
         async_pipeline=False,
         liveness=None,
         edge_log_capacity=None,
         wave_kernel="staged",
+        config_notes=None,
     ):
         model = options.model
         if not isinstance(model, BatchableModel):
@@ -678,8 +680,9 @@ class TpuBfsChecker(Checker):
         self._wave_kernel = wave_kernel
         # Run-configuration notes, surfaced once at run end through
         # ``Reporter.report_config_notes`` — a silently adjusted knob is
-        # a dishonest one.
-        self.config_notes: List[str] = []
+        # a dishonest one. Callers (the service's warm-start plane) may
+        # pre-seed notes of their own.
+        self.config_notes: List[str] = list(config_notes or ())
         if wave_kernel == "fused":
             # The fused wave grids over TILE_ROWS-row table tiles; round
             # the capacity up to the next admissible size (and say so)
@@ -1050,6 +1053,30 @@ class TpuBfsChecker(Checker):
             sig = self._aot_signature()
             self._wave_exec = shared_aot_cache(aot_cache, ("wave",) + sig)
             self._drain_exec = shared_aot_cache(aot_cache, ("drain",) + sig)
+        # Disk tier of the AOT cache (warm-start plane): serialized
+        # executables persist under the service dir, fenced on
+        # jax-version/backend/topology so a fresh PROCESS serves its
+        # first job compile-free. Probed only on in-memory misses; a
+        # disk hit bypasses the compile phase entirely (the attribution
+        # ledger records zero compile), a refused entry is a miss.
+        self._aot_disk = None
+        if aot_store is not None:
+            if aot_cache is None:
+                raise ValueError(
+                    "aot_store requires aot_cache=<namespace>: the disk "
+                    "entries inherit the namespace's semantic-equivalence "
+                    "assertion (see shared_aot_cache)"
+                )
+            from ..storage.persist import AotDiskStore
+
+            store = (
+                aot_store
+                if isinstance(aot_store, AotDiskStore)
+                else AotDiskStore(aot_store)
+            )
+            self._aot_disk = store.binding(
+                aot_cache, self._aot_signature(), registry=self._registry
+            )
         self._jit_pool_zero = jax.jit(self._pool_zero, static_argnums=(0,))
         # The ring is rebound to the returned one; the pushed chunk's
         # buffers cannot alias the ring (scatter), so donating them would
@@ -2024,6 +2051,17 @@ class TpuBfsChecker(Checker):
             args = (table, self._elog) + args[1:]
         key = (table.shape[0], chunk["hi"].shape[0])
         exe = self._wave_exec.get(key)
+        if exe is not None and self._aot_disk is not None:
+            # Warm-memory / cold-disk: backfill so a later fresh process
+            # still finds the artifact (one existence probe per key).
+            self._aot_disk.ensure("wave", key, exe)
+        if exe is None and self._aot_disk is not None:
+            # Disk tier of the AOT cache: a fenced hit deserializes the
+            # executable OUTSIDE the compile phase/span — the whole
+            # point is that the attribution ledger records no compile.
+            exe = self._aot_disk.load("wave", key)
+            if exe is not None:
+                self._wave_exec[key] = exe
         if exe is None:
             t0 = time.perf_counter()
             # AOT-cache miss == a compile is about to happen: the ONE
@@ -2037,6 +2075,8 @@ class TpuBfsChecker(Checker):
             if self.warmup_seconds is not None:
                 self.warmup_seconds += time.perf_counter() - t0
                 self._wi.warmup.set(self.warmup_seconds)
+            if self._aot_disk is not None:
+                self._aot_disk.save("wave", key, exe)
         if self._attr is None:
             out = exe(*args)
         else:
@@ -2802,6 +2842,16 @@ class TpuBfsChecker(Checker):
         steady-state window honest."""
         key = (width, args[0].shape[0], self._pool_capacity)
         exe = self._drain_exec.get(key)
+        if exe is not None and self._aot_disk is not None:
+            # Warm-memory / cold-disk backfill, same as the wave site.
+            self._aot_disk.ensure("drain", key, exe)
+        if exe is None and self._aot_disk is not None:
+            # Disk tier (warm-start plane): a fenced hit loads the rung
+            # outside the compile phase — cross-process warm starts
+            # record zero compile, exactly like the in-memory hit below.
+            exe = self._aot_disk.load("drain", key)
+            if exe is not None:
+                self._drain_exec[key] = exe
         if exe is not None and self.warmup_seconds is None:
             # Warm start (shared AOT cache hit on the very first drain):
             # stamp the setup-only warmup now. Leaving it None would
@@ -2833,6 +2883,8 @@ class TpuBfsChecker(Checker):
             else:
                 self.warmup_seconds += time.perf_counter() - t0
                 self._wi.warmup.set(self.warmup_seconds)
+            if self._aot_disk is not None:
+                self._aot_disk.save("drain", key, exe)
         return exe
 
     def _export_pool_chunks(self, pool, head, count):

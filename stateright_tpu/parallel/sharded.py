@@ -152,6 +152,7 @@ class ShardedTpuBfsChecker(Checker):
         async_pipeline=False,
         liveness=None,
         wave_kernel="staged",
+        aot_store=None,
         sieve=None,
         sieve_slots_per_device=None,
         sieve_bloom_bits=None,
@@ -454,6 +455,15 @@ class ShardedTpuBfsChecker(Checker):
             donate_argnums=wave_donate,
         )
         self._wave_exec = {}  # (local capacity, chunk width) -> AOT wave
+        # Disk tier of the wave-executable cache (warm-start plane,
+        # storage/persist.py): bound lazily at the first wave dispatch —
+        # the trace-relevant attributes (liveness, coverage, sieve) are
+        # not all set yet at this point in __init__. The deep drain is
+        # NOT disk-cached here: its compile site pre-compiles inline and
+        # dispatches through the jit object, so there is no executable
+        # handle to persist without restructuring the drain loop.
+        self._aot_store_arg = aot_store
+        self._aot_disk = None
         self._jit_insert = jax.jit(
             shard_map(
                 self._insert_local,
@@ -2113,6 +2123,49 @@ class ShardedTpuBfsChecker(Checker):
         path = self._checkpoint_path
         self._pipe.submit(lambda: self._checkpoint_write(path, payload))
 
+    def _aot_disk_binding(self):
+        """The disk AOT binding, built on first use (every trace-relevant
+        attribute is set by the first wave). The signature mirrors the
+        single-device checker's ``_aot_signature`` for the knobs the
+        sharded trace closes over — backend, topology, model digest,
+        properties, capacities, ladder, sieve, liveness, coverage — so a
+        config drift misses instead of loading the wrong executable."""
+        if self._aot_store_arg is None:
+            return None
+        if self._aot_disk is None:
+            from ..checker.tpu import packed_model_digest
+            from ..storage.persist import AotDiskStore
+
+            store = (
+                self._aot_store_arg
+                if isinstance(self._aot_store_arg, AotDiskStore)
+                else AotDiskStore(self._aot_store_arg)
+            )
+            sig = (
+                "sharded_wave",
+                jax.default_backend(),
+                jax.process_count(),
+                self._n,
+                packed_model_digest(self._model, self._A),
+                tuple(
+                    (p.name, str(p.expectation)) for p in self._properties
+                ),
+                self._F_loc,
+                self._cap_loc,
+                tuple(self._buckets),
+                bool(self._sieve),
+                self._sieve_slots if self._sieve else None,
+                self._sieve_bits if self._sieve else None,
+                self._live_enabled,
+                self._cov is not None,
+                self._fleet_on,
+            )
+            self._aot_disk = store.binding(
+                f"sharded:{type(self._model).__name__}", sig,
+                registry=self._registry,
+            )
+        return self._aot_disk
+
     def _call_wave(self, table, dev, depth_cap):
         """Wave through an AOT-compiled executable (keyed by local table
         capacity): a mid-run compile (table growth changes the shape) is
@@ -2137,6 +2190,21 @@ class ShardedTpuBfsChecker(Checker):
             args = args + self._sieve_dev
         key = (table.shape[0], dev["hi"].shape[0])
         exe = self._wave_exec.get(key)
+        if exe is not None:
+            disk = self._aot_disk_binding()
+            if disk is not None:
+                # Warm-memory / cold-disk backfill, same as the solo
+                # checker's wave site.
+                disk.ensure("wave", key, exe)
+        if exe is None:
+            disk = self._aot_disk_binding()
+            if disk is not None:
+                # Disk tier (warm-start plane): a fenced hit skips the
+                # compile phase entirely — cross-process sharded runs
+                # record zero wave compiles.
+                exe = disk.load("wave", key)
+                if exe is not None:
+                    self._wave_exec[key] = exe
         if exe is None:
             t0 = time.perf_counter()
             # AOT-cache miss: the attribution engine's compile-detection
@@ -2146,6 +2214,9 @@ class ShardedTpuBfsChecker(Checker):
             self._wave_exec[key] = exe
             if self.warmup_seconds is not None:
                 self.warmup_seconds += time.perf_counter() - t0
+            disk = self._aot_disk_binding()
+            if disk is not None:
+                disk.save("wave", key, exe)
         if self._attr is None:
             out = exe(*args)
         else:

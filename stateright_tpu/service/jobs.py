@@ -191,6 +191,16 @@ class CheckJob:
         # the reason (e.g. a backend without device liveness).
         self.liveness_mode: Optional[str] = None
         self.liveness_reason: Optional[str] = None
+        # Warm-start plane: ``warm_pool`` marks the service's internal
+        # pre-compile jobs (excluded from SLO rows and the seed store);
+        # ``warm_start`` means this run was seeded from a persisted
+        # finished run (``seeded_from`` names the seed signature and
+        # tier counts); ``warm_start_reason`` records why a seed was
+        # NOT used (the honest conservative-fallback evidence).
+        self.warm_pool = False
+        self.warm_start = False
+        self.seeded_from: Optional[dict] = None
+        self.warm_start_reason: Optional[str] = None
         # Budget-derived device table sizing (None = service default).
         self.derived_table_capacity: Optional[int] = None
         # Pack-membership clock: join time of the current packed slice.
@@ -375,6 +385,10 @@ class CheckJob:
                 "packed": self.packed,
                 "liveness_mode": self.liveness_mode,
                 "liveness_reason": self.liveness_reason,
+                "warm_pool": self.warm_pool,
+                "warm_start": self.warm_start,
+                "seeded_from": self.seeded_from,
+                "warm_start_reason": self.warm_start_reason,
                 "preempts": self.preempts,
                 "slices": self.slices,
                 "retries": self.retries,

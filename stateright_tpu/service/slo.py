@@ -126,10 +126,25 @@ class SLOLedger:
         if mode not in self._obs:
             return
         lat = job.latency()
+        # Compile-share evidence (warm-start plane): ``compile_s`` is
+        # the job's summed warmup; ``compile_free`` is derived from the
+        # per-job disk-AOT counters — a disk miss is exactly one fresh
+        # compile, so zero misses means every executable came from a
+        # cache (memory or disk). None when the job had no AOT binding.
+        aot = None
+        result = getattr(job, "result", None)
+        if isinstance(result, dict):
+            aot = result.get("aot")
+        compile_free = None
+        if aot is not None:
+            compile_free = aot.get("aot_cache.disk_miss", 0) == 0
         row = {
             "job_id": job.job_id,
             "verdict_s": lat["wall_s"],
             "queued_s": lat["queued_s"],
+            "compile_s": float(job.warmup_s),
+            "compile_free": compile_free,
+            "warm_start": bool(getattr(job, "warm_start", False)),
             "decomposition": decompose_ttfv(
                 lat["ttfv_s"], lat["queued_s"], job.warmup_s
             ),
@@ -149,6 +164,12 @@ class SLOLedger:
         verdicts = [r["verdict_s"] for r in rows]
         decomps = [r["decomposition"] for r in rows if r["decomposition"]]
         ttfvs = [d["ttfv_s"] for d in decomps]
+        compiles = [
+            r["compile_s"] for r in rows if r.get("compile_s") is not None
+        ]
+        known_free = [
+            r for r in rows if r.get("compile_free") is not None
+        ]
         view = {
             "jobs": jobs,
             "window": len(rows),
@@ -161,6 +182,20 @@ class SLOLedger:
                 "count": len(verdicts),
                 "p50_s": _pct(verdicts, 50),
                 "p99_s": _pct(verdicts, 99),
+            },
+            "compile": {
+                "count": len(compiles),
+                "p50_s": _pct(compiles, 50),
+                "p99_s": _pct(compiles, 99),
+                "free_fraction": (
+                    sum(1 for r in known_free if r["compile_free"])
+                    / len(known_free)
+                    if known_free
+                    else None
+                ),
+                "warm_start_jobs": sum(
+                    1 for r in rows if r.get("warm_start")
+                ),
             },
             "decomposition": {
                 phase: {
@@ -198,6 +233,14 @@ class SLOLedger:
         for phase, block in view["decomposition"].items():
             if block["p50_s"] is not None:
                 self._gauge(mode, f"{phase}_p50").set(block["p50_s"])
+        comp = view["compile"]
+        for stat in ("p50_s", "p99_s"):
+            if comp[stat] is not None:
+                self._gauge(mode, f"compile_{stat}").set(comp[stat])
+        if comp["free_fraction"] is not None:
+            self._gauge(mode, "compile_free_fraction").set(
+                comp["free_fraction"]
+            )
         for key, rate in view.get("burn_rate", {}).items():
             self._gauge(mode, f"{key}_burn_rate").set(rate)
 

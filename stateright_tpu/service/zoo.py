@@ -89,6 +89,19 @@ def default_zoo() -> Dict[str, Callable]:
     }
 
 
+def warm_shapes():
+    """The ``(name, args)`` pairs a ``warm_pool=True`` service
+    pre-compiles at start: the default configurations of the zoo's
+    small always-checkable workloads. Kept deliberately short — each
+    shape costs one depth-2 background job at service start (compile
+    time when the disk AOT store is cold, milliseconds when warm)."""
+    return [
+        ("2pc", {}),
+        ("abd", {}),
+        ("increment_lock", {}),
+    ]
+
+
 def aot_namespace(model_name: str, model_args: dict) -> str:
     """Deterministic AOT-cache namespace for one zoo configuration: the
     name plus the sorted args. Jobs sharing it assert their models are

@@ -28,6 +28,16 @@ See README "Memory hierarchy" for the knobs and when eviction pays.
 
 from .bloom import BloomFilter
 from .edge_log import LivenessEdgeStore, LivenessInstruments
+from .persist import (
+    AotDiskBinding,
+    AotDiskStore,
+    SeedStore,
+    aot_fence,
+    adapt_seed_checkpoint,
+    build_seed_artifact,
+    model_structure_signature,
+    seed_compatibility,
+)
 from .runs import (
     RUN_BLOCK,
     FingerprintRun,
@@ -45,8 +55,16 @@ from .tiered import (
 )
 
 __all__ = [
+    "AotDiskBinding",
+    "AotDiskStore",
     "BloomFilter",
     "FingerprintRun",
+    "SeedStore",
+    "adapt_seed_checkpoint",
+    "aot_fence",
+    "build_seed_artifact",
+    "model_structure_signature",
+    "seed_compatibility",
     "LivenessEdgeStore",
     "LivenessInstruments",
     "RUN_BLOCK",

@@ -121,6 +121,13 @@ class LivenessEvictFault(FaultError):
     fault_class = "liveness_evict"
 
 
+class SeedLoadFault(OSError, FaultError):
+    """A warm-start seed artifact read died (torn file, failing disk) —
+    the honest outcome is a refused seed and a full recheck."""
+
+    fault_class = "seed_load"
+
+
 class TenantFaultError(Exception):
     """An engine fault attributable to exactly ONE packed tenant — the
     pack's blast-radius boundary. The service drops only this tenant
@@ -195,6 +202,9 @@ _SITE_EXC = {
     # radius.
     "swarm.wave": DeviceWaveFault,
     "swarm.tenant.verdict": PackTenantFault,
+    # Warm-start plane (storage/persist.py): the seed-artifact read —
+    # refusal must degrade to a full recheck, never a wrong verdict.
+    "warmstart.seed_load": SeedLoadFault,
 }
 
 # Sites that exist in the tree — fail fast on typos in test specs.

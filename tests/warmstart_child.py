@@ -1,0 +1,93 @@
+"""Child process for the cross-process warm-start tests.
+
+Each invocation is one genuinely fresh process (cold jax, cold
+in-memory AOT caches) serving a single 2pc-3 job against a shared
+``service_dir``. The driver (``tests/test_warmstart.py``) runs it twice
+with the same directory: the first child populates the disk AOT store
+(``service_dir/aot/``), the second must serve its job compile-free off
+it — the tentpole's "a fresh process serves its first job compile-free"
+claim, exercised with a real process boundary rather than the
+in-process ``clear_shared_aot_caches()`` emulation bench.py uses.
+
+Usage: ``python warmstart_child.py <service_dir> [mode]``
+
+Modes:
+- ``aot`` (default) — a ``target_max_depth`` job (kept OUT of the seed
+  plane by its target) on a ``packing=False`` service: isolates the
+  disk-AOT executable plane from incremental re-checking.
+- ``seed`` — a plain full-space job: first child saves a finished-run
+  seed, second child's resubmission must reseed (zero explore waves).
+
+The output is one ``WARMSTART-CHILD {json}`` line with the per-job
+``aot_cache.*`` counters, the summed ``pipeline.compile_seconds``
+phases, and the verdict — the driver gates on those.
+"""
+
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+service_dir = sys.argv[1]
+mode = sys.argv[2] if len(sys.argv) > 2 else "aot"
+
+from stateright_tpu.service import CheckService  # noqa: E402
+from stateright_tpu.telemetry import (  # noqa: E402
+    metrics_registry,
+    registry_hygiene_problems,
+)
+
+SPAWN = {
+    "frontier_capacity": 16,
+    "table_capacity": 1 << 12,
+    "max_drain_waves": 2,
+}
+
+svc = CheckService(
+    service_dir=service_dir,
+    packing=False,
+    quantum_s=60.0,
+    default_spawn=dict(SPAWN),
+)
+# The depth target exceeds 2pc-3's true depth: the space is explored in
+# full (verdicts are the real ones) while the target keeps the job out
+# of the seed plane — the disk-AOT evidence stays uncontaminated.
+options = {"target_max_depth": 64} if mode == "aot" else None
+handle = svc.submit(
+    model_name="2pc", model_args={"rm_count": 3}, options=options
+)
+result = handle.result(timeout=300.0)
+status = handle.status()
+snap = metrics_registry(handle.job_id).snapshot()
+compile_phase_s = sum(
+    v
+    for k, v in snap.items()
+    if k.endswith("pipeline.compile_seconds") and isinstance(v, (int, float))
+)
+waves = int(snap.get("tpu_bfs.waves", 0))
+print(
+    "WARMSTART-CHILD "
+    + json.dumps(
+        {
+            "mode": mode,
+            "unique": result["unique"],
+            "properties_hold": result["properties_hold"],
+            "aot": result.get("aot"),
+            "warm_start": bool(status.get("warm_start")),
+            "seeded_from": status.get("seeded_from"),
+            "compile_phase_s": compile_phase_s,
+            "waves": waves,
+            # Metric-name lint over BOTH registries this process touched
+            # (default carries warmstart.*/aot_cache.* service counters,
+            # the job registry carries the per-tenant copies).
+            "hygiene": (
+                registry_hygiene_problems()
+                + registry_hygiene_problems(metrics_registry(handle.job_id))
+            ),
+        }
+    )
+)
+svc.close()
