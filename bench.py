@@ -1477,6 +1477,244 @@ def _main_slo():
     print(json.dumps(record))
 
 
+CONFORMANCE_TIMEOUT_S = 1800
+
+
+def _run_conformance_leg(pin_cpu: bool):
+    """Child entry: the conformance-plane throughput legs (BENCH_r20).
+
+    (a) **replay**: traces/sec through the vmapped trace replayer at
+        batch sizes 1/64/1024 (one jitted ``vmap(lax.scan)`` dispatch
+        per batch) — the batching win is the headline: a 1024-lane
+        batch must amortize dispatch overhead that dominates at
+        batch=1.
+    (b) **audit**: histories/sec through the batched device
+        linearizability tester at the same batch sizes.
+    (c) **divergence-rate sweep**: replay throughput at 0/10/50%
+        divergent lanes — the kernel is branchless (a diverged lane
+        keeps riding the scan, masked), so throughput must be flat in
+        the divergence rate; a slope would mean divergence handling
+        re-introduced per-lane control flow.
+
+    Warm convention: every shape dispatches twice, the first run pays
+    the compile (recorded as *_cold_s), the second is the steady-state
+    headline — the number a resident service's warm pool serves.
+    """
+    import jax
+
+    if pin_cpu:
+        jax.config.update("jax_platforms", "cpu")
+    from stateright_tpu.utils.compile_cache import enable_persistent_cache
+
+    enable_persistent_cache()
+    import random as _random
+
+    from stateright_tpu.conformance import (
+        audit_batch,
+        mutate_trace,
+        random_history,
+        random_walk_trace,
+        replay_batch,
+    )
+    from stateright_tpu.service.zoo import aot_namespace, default_zoo
+
+    device = jax.devices()[0]
+    log(f"[conformance] device: {device.platform} ({device})")
+    model_name = "increment_lock"
+    model = default_zoo()[model_name]()
+    ns = aot_namespace(model_name, {})
+    rng = _random.Random(20)
+    T = 16
+    batches = (1, 64, 1024)
+
+    # One pool of distinct seeded walks, replicated (fresh ids) up to
+    # the largest batch: verdict work is per-lane, so replication keeps
+    # generation cheap without making lanes degenerate.
+    walk_pool = [
+        random_walk_trace(
+            model, rng, T, rec_id=f"w{i}", model_name=model_name
+        )
+        for i in range(32)
+    ]
+    divergent_pool = []
+    for rec in walk_pool:
+        mut = mutate_trace(model, rng, rec)
+        if mut is not None:
+            divergent_pool.append(mut)
+    assert divergent_pool, "no mutation sites in the walk pool"
+
+    def trace_batch(n, divergent_fraction=0.0):
+        out = []
+        n_div = int(round(n * divergent_fraction))
+        for i in range(n):
+            src = (
+                divergent_pool[i % len(divergent_pool)]
+                if i < n_div else walk_pool[i % len(walk_pool)]
+            )
+            out.append(dict(src, id=f"{src['id']}-{i}"))
+        return out
+
+    def time_replay(recs, lanes):
+        def once():
+            t0 = time.perf_counter()
+            verdicts = replay_batch(recs, model, ns, T, lanes=lanes)
+            return verdicts, time.perf_counter() - t0
+
+        _v, cold = once()
+        verdicts, warm = once()
+        return verdicts, warm, cold
+
+    out = {
+        "device": device.platform,
+        "model": model_name,
+        "trace_steps": T,
+        "replay": {},
+        "audit": {},
+        "divergence_sweep": {},
+    }
+
+    # (a) replay throughput vs batch size.
+    for n in batches:
+        recs = trace_batch(n)
+        verdicts, warm, cold = time_replay(recs, lanes=n)
+        assert all(v["conforms"] for v in verdicts)
+        rate = n / max(warm, 1e-9)
+        out["replay"][str(n)] = {
+            "traces_per_s": rate, "warm_s": warm, "cold_s": cold,
+        }
+        log(
+            f"[conformance] replay batch={n}: {rate:,.0f} traces/s "
+            f"(warm {warm * 1e3:.1f}ms, cold {cold:.2f}s)"
+        )
+    b1 = out["replay"]["1"]["traces_per_s"]
+    bmax = out["replay"][str(batches[-1])]["traces_per_s"]
+    out["replay_batch_amortization"] = bmax / max(b1, 1e-9)
+
+    # (b) audit throughput vs batch size (one shape bucket: the
+    # register C=2/O=2 linearizability grid).
+    hist_pool = [
+        random_history(
+            rng, spec="register", semantics="linearizability",
+            threads=2, ops_per_thread=2,
+            mode=("clean", "random")[i % 2], rec_id=f"h{i}",
+        )
+        for i in range(64)
+    ]
+    # Replication must preserve the bucket: drop the occasional
+    # off-shape history (a tail op left in flight can reduce O).
+    from stateright_tpu.conformance import bucket_key
+
+    key0 = bucket_key(hist_pool[0])
+    hist_pool = [h for h in hist_pool if bucket_key(h) == key0]
+    for n in batches:
+        recs = [
+            dict(hist_pool[i % len(hist_pool)], id=f"h{i}-{n}")
+            for i in range(n)
+        ]
+
+        def once():
+            t0 = time.perf_counter()
+            verdicts = audit_batch(recs)
+            return verdicts, time.perf_counter() - t0
+
+        _v, cold = once()
+        verdicts, warm = once()
+        assert all("refused" not in v for v in verdicts)
+        rate = n / max(warm, 1e-9)
+        out["audit"][str(n)] = {
+            "histories_per_s": rate, "warm_s": warm, "cold_s": cold,
+        }
+        log(
+            f"[conformance] audit batch={n}: {rate:,.0f} histories/s "
+            f"(warm {warm * 1e3:.1f}ms, cold {cold:.2f}s)"
+        )
+
+    # (c) divergence-rate sweep at the largest batch: branchless lanes
+    # => flat throughput.
+    n = batches[-1]
+    for frac in (0.0, 0.1, 0.5):
+        recs = trace_batch(n, divergent_fraction=frac)
+        verdicts, warm, _cold = time_replay(recs, lanes=n)
+        n_div = sum(1 for v in verdicts if not v["conforms"])
+        assert n_div == int(round(n * frac)), (n_div, frac)
+        rate = n / max(warm, 1e-9)
+        out["divergence_sweep"][f"{int(frac * 100)}pct"] = {
+            "traces_per_s": rate, "divergent_lanes": n_div,
+        }
+        log(
+            f"[conformance] divergence {int(frac * 100)}%: "
+            f"{rate:,.0f} traces/s"
+        )
+    rates = [
+        v["traces_per_s"] for v in out["divergence_sweep"].values()
+    ]
+    out["divergence_flatness"] = min(rates) / max(max(rates), 1e-9)
+    print(json.dumps(out))
+
+
+def _main_conformance():
+    """Parent entry for ``bench.py --conformance``: runs the
+    conformance throughput legs in a child (wedge isolation) and writes
+    ``BENCH_r20.json`` (override with ``--conformance-out PATH``),
+    printing the same record as the one JSON line. Render the
+    trajectory with ``scripts/bench_compare.py --conformance``."""
+    on_accel = _accelerator_usable()
+
+    def run(pin_cpu):
+        argv = [sys.executable, __file__, "--conformance-leg"]
+        if pin_cpu:
+            argv.append("--cpu")
+        return _child_json(
+            argv, CONFORMANCE_TIMEOUT_S * (3 if pin_cpu else 1),
+            "conformance",
+        )
+
+    rec = run(pin_cpu=not on_accel)
+    if rec is None and on_accel:
+        log("[conformance] falling back to CPU-pinned run")
+        rec = run(pin_cpu=True)
+    if rec is None:
+        print(
+            json.dumps(
+                {
+                    "metric": "conformance replay throughput "
+                    "(1024-lane batch)",
+                    "value": 0,
+                    "unit": "traces/sec",
+                    "error": "conformance leg failed on every backend",
+                }
+            )
+        )
+        return
+    headline = rec["replay"]["1024"]["traces_per_s"]
+    record = {
+        "metric": "conformance replay throughput (1024-lane batch, "
+        "vmapped trace replayer)",
+        "value": round(headline, 1),
+        "unit": "traces/sec",
+        "conformance": rec,
+    }
+    if rec.get("divergence_flatness", 1.0) < 0.5:
+        log(
+            "[conformance] WARNING: throughput is not flat in the "
+            f"divergence rate (min/max {rec['divergence_flatness']:.2f})"
+        )
+    out_path = None
+    for i, arg in enumerate(sys.argv):
+        if arg == "--conformance-out" and i + 1 < len(sys.argv):
+            out_path = sys.argv[i + 1]
+        elif arg.startswith("--conformance-out="):
+            out_path = arg.split("=", 1)[1]
+    if out_path is None:
+        out_path = os.path.join(REPO_DIR, "BENCH_r20.json")
+    with open(out_path, "w") as f:
+        # One JSON line, like every BENCH_r* record (the line-oriented
+        # readers scan for the "conformance" key).
+        f.write(json.dumps(record) + "\n")
+    log(f"[conformance] record written to {out_path}")
+    print(json.dumps(record))
+
+
 ASYNC_AB_TIMEOUT_S = 1800
 
 
@@ -2746,6 +2984,10 @@ def main():
         return _run_slo_leg("--cpu" in sys.argv)
     if "--slo" in sys.argv:
         return _main_slo()
+    if "--conformance-leg" in sys.argv:
+        return _run_conformance_leg("--cpu" in sys.argv)
+    if "--conformance" in sys.argv:
+        return _main_conformance()
     if "--service" in sys.argv:
         return _main_service()
     if "--async-ab-leg" in sys.argv:

@@ -547,6 +547,85 @@ def swarm_report(path, out=sys.stdout):
     return 0
 
 
+def conformance_report(path, out=sys.stdout):
+    """The conformance-plane throughput table from one ``bench.py
+    --conformance`` record (BENCH_r20): replay traces/sec and audit
+    histories/sec vs batch size (the batching-amortization story), and
+    the divergence-rate sweep (flat = the replay kernel stayed
+    branchless). Always advisory (exit 0 when the record parsed):
+    wall-clock claims are noise on shared CPU boxes; the bit-identity
+    asserts live in the parity suite."""
+    with open(path) as f:
+        rec = None
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                obj = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(obj, dict) and "conformance" in obj:
+                rec = obj
+    if rec is None:
+        print(
+            f"error: {path}: no conformance record found (produce one "
+            "with bench.py --conformance)",
+            file=sys.stderr,
+        )
+        return 2
+    conf = rec["conformance"]
+    out.write(
+        f"conformance plane ({conf.get('device')}, "
+        f"{conf.get('model')} traces, T={conf.get('trace_steps')})\n\n"
+    )
+    header = (
+        f"{'batch':>6} {'replay traces/s':>16} {'warm':>9} "
+        f"{'cold':>7} {'audit hist/s':>13} {'warm':>9}"
+    )
+    out.write(header + "\n" + "-" * len(header) + "\n")
+    batches = sorted(
+        set(conf.get("replay") or {}) | set(conf.get("audit") or {}),
+        key=int,
+    )
+    for b in batches:
+        rp = (conf.get("replay") or {}).get(b) or {}
+        au = (conf.get("audit") or {}).get(b) or {}
+
+        def ms(v):
+            return "-" if v is None else f"{v * 1e3:,.1f}ms"
+
+        out.write(
+            f"{b:>6} {_fmt(rp.get('traces_per_s')):>16} "
+            f"{ms(rp.get('warm_s')):>9} "
+            f"{_fmt(rp.get('cold_s')) + 's':>7} "
+            f"{_fmt(au.get('histories_per_s')):>13} "
+            f"{ms(au.get('warm_s')):>9}\n"
+        )
+    amort = conf.get("replay_batch_amortization")
+    if amort is not None:
+        out.write(
+            f"\nbatch amortization: {amort:,.0f}x traces/s at the "
+            "widest batch vs batch=1\n"
+        )
+    sweep = conf.get("divergence_sweep") or {}
+    if sweep:
+        out.write("\ndivergence-rate sweep (widest batch)\n")
+        for label, v in sweep.items():
+            out.write(
+                f"  {label:>6}: {_fmt(v.get('traces_per_s')):>12} "
+                f"traces/s ({v.get('divergent_lanes', 0):,} divergent "
+                "lanes)\n"
+            )
+        flat = conf.get("divergence_flatness")
+        if flat is not None:
+            out.write(
+                f"  flatness (min/max): {flat:.2f} "
+                "(~1.0 = branchless, rate-independent)\n"
+            )
+    return 0
+
+
 def multichip_trajectory(paths, out=sys.stdout):
     """The pod-scale sharding trajectory across ``MULTICHIP_r*.json``
     records (r01 dryruns -> r06 sieve A/B scaling curve): one summary
@@ -882,6 +961,12 @@ def main(argv=None):
         "record",
     )
     parser.add_argument(
+        "--conformance", action="store_true",
+        help="render the conformance-plane throughput table (replay "
+        "traces/s and audit histories/s vs batch size, divergence-rate "
+        "sweep) from one bench.py --conformance record",
+    )
+    parser.add_argument(
         "--multichip", action="store_true",
         help="render the pod-scale sharding trajectory across "
         "MULTICHIP_r*.json records (per-file verdicts, then the newest "
@@ -922,6 +1007,19 @@ def main(argv=None):
             return 2
         try:
             return megakernel_report(args.files[0])
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"error: {args.files[0]}: {e}", file=sys.stderr)
+            return 2
+
+    if args.conformance:
+        if len(args.files) != 1:
+            print(
+                "error: --conformance takes exactly one bench record",
+                file=sys.stderr,
+            )
+            return 2
+        try:
+            return conformance_report(args.files[0])
         except (OSError, json.JSONDecodeError) as e:
             print(f"error: {args.files[0]}: {e}", file=sys.stderr)
             return 2

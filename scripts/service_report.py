@@ -94,15 +94,16 @@ def summarize(rec):
         "liveness_downgraded": sum(
             1 for j in per_job if j.get("liveness_reason")
         ),
-        # Verification modes (swarm PR): exhaustive BFS vs randomized
-        # walk jobs sharing the one device.
+        # Verification modes (swarm PR; conformance PR): exhaustive BFS,
+        # randomized-walk, and trace-replay/audit jobs sharing the one
+        # device.
         "modes": {
             mode: sum(
                 1
                 for j in per_job
                 if j.get("mode", "exhaustive") == mode
             )
-            for mode in ("exhaustive", "swarm")
+            for mode in ("exhaustive", "swarm", "conformance")
             if any(
                 j.get("mode", "exhaustive") == mode for j in per_job
             )
@@ -212,7 +213,7 @@ def render(summary, out=sys.stdout):
             "over warm)\n"
         )
     vmodes = summary.get("modes") or {}
-    if len(vmodes) > 1 or "swarm" in vmodes:
+    if len(vmodes) > 1 or "swarm" in vmodes or "conformance" in vmodes:
         w(
             "  modes: "
             + ", ".join(f"{n} {m}" for m, n in sorted(vmodes.items()))

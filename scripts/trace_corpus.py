@@ -1,0 +1,118 @@
+#!/usr/bin/env python
+"""Generate a labeled conformance corpus as wire-format JSONL.
+
+    python scripts/trace_corpus.py --seed 7 --out corpus.jsonl
+    python scripts/trace_corpus.py --store ./svc/corpus --name nightly
+
+Drives ``conformance/corpus.py``: seeded random-walk traces (clean by
+construction) plus mutated divergent twins per zoo model, and
+clean/random/invalid histories per (spec, semantics, threads, ops)
+shape. Every record carries its ground-truth label in ``meta`` —
+``expect`` and, for divergent traces, the exact ``divergence_index`` /
+``offending_action`` — which the parity suite and the tier-1 smoke read
+back against the device verdicts.
+
+``--store/--name`` saves into a service's named ``CorpusStore``
+(``service_dir/corpus``) so a running server can audit it by name over
+HTTP: ``POST /jobs {"mode": "conformance", "corpus": "nightly"}``.
+Deterministic: same seed + options -> byte-identical corpus.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+sys.path.insert(
+    0, __file__.rsplit("/", 2)[0]
+)  # repo root, when run as a script
+
+from stateright_tpu.conformance import encode_record, generate_corpus
+from stateright_tpu.service.zoo import default_zoo
+
+DEFAULT_MODELS = ("increment_lock", "2pc")
+
+DEFAULT_HISTORY_SHAPES = (
+    ("register", "linearizability", 2, 2),
+    ("register", "sequential", 2, 2),
+    ("register", "linearizability", 3, 2),
+    ("vec", "linearizability", 2, 2),
+)
+
+
+def build_lines(args) -> list:
+    zoo = default_zoo()
+    specs = []
+    for name in args.models:
+        if name not in zoo:
+            raise SystemExit(
+                f"unknown model {name!r}; zoo: {sorted(zoo)}"
+            )
+        specs.append((name, {}, zoo[name]()))
+    records = generate_corpus(
+        args.seed,
+        model_specs=specs,
+        traces_per_model=args.traces_per_model,
+        mutated_per_model=args.mutated_per_model,
+        trace_steps=args.trace_steps,
+        histories=args.histories,
+        history_shapes=DEFAULT_HISTORY_SHAPES,
+    )
+    return [encode_record(r) for r in records]
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="Generate a labeled conformance corpus "
+        "(wire-format JSONL)."
+    )
+    parser.add_argument("--seed", type=int, default=7)
+    parser.add_argument(
+        "--models", nargs="+", default=list(DEFAULT_MODELS),
+        help="zoo models to draw traces from",
+    )
+    parser.add_argument("--traces-per-model", type=int, default=4)
+    parser.add_argument("--mutated-per-model", type=int, default=2)
+    parser.add_argument("--trace-steps", type=int, default=12)
+    parser.add_argument("--histories", type=int, default=24)
+    parser.add_argument(
+        "--out", default=None,
+        help="write JSONL here ('-' = stdout; default stdout)",
+    )
+    parser.add_argument(
+        "--store", default=None,
+        help="save into a CorpusStore root (a service_dir/corpus)",
+    )
+    parser.add_argument(
+        "--name", default=None,
+        help="corpus name inside --store (a name, never a path)",
+    )
+    args = parser.parse_args(argv)
+    if (args.store is None) != (args.name is None):
+        parser.error("--store and --name go together")
+    lines = build_lines(args)
+    if args.store is not None:
+        from stateright_tpu.storage.corpus import CorpusStore
+
+        path = CorpusStore(args.store).save(args.name, lines)
+        print(
+            f"saved {len(lines)} records as corpus {args.name!r} "
+            f"({path})",
+            file=sys.stderr,
+        )
+    if args.out is not None and args.out != "-":
+        with open(args.out, "w", encoding="utf-8") as f:
+            for line in lines:
+                f.write(line + "\n")
+        print(
+            f"wrote {len(lines)} records to {args.out}", file=sys.stderr
+        )
+    elif args.store is None or args.out == "-":
+        for line in lines:
+            print(line)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,234 @@
+"""Vmapped trace-conformance replay over the packed model.
+
+The swarm's walk kernel (``checker/tpu_simulation.walk_lane_step``)
+samples its next action with ``jax.random.categorical``; conformance
+replay is the same lane loop with the sampler replaced by the *trace* —
+each lane replays one uploaded action sequence through
+``model.packed_step`` and reports whether the recorded execution is a
+behaviour of the model:
+
+- a step whose ``valid`` bit is False is a **divergence**: the recorded
+  action's guard does not hold where the trace claims it fired (the
+  host model would never have enumerated it there). The verdict is the
+  first divergence index plus the offending action id, per lane — the
+  exact "your deployment did something the model forbids, here" answer.
+- lanes are traces; a bucket of same-shape traces (same model config,
+  same padded length T) is ONE jitted ``vmap(lax.scan)`` dispatch, so
+  a resident service replays thousands of traces per dispatch at wave
+  throughput.
+
+``replay_host`` is the parity oracle: the same loop as concrete host
+python, diffed bit-for-bit (divergence index AND offending action) by
+the parity suite and the checker's gate. Padding is honest: action
+slots past a trace's real length are -1 and never step, so a short
+trace in a long bucket cannot pick up phantom divergences.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+def _gather_inits(model, init_indices: Sequence[int]):
+    """Stacks the requested rows of ``packed_init_states()`` into a
+    lane-batched pytree (host-side; init indices were validated at
+    ingestion)."""
+    import jax
+
+    idx = np.asarray(list(init_indices), np.int32)
+    seeds = model.packed_init_states()
+    return jax.tree_util.tree_map(lambda x: np.asarray(x)[idx], seeds)
+
+
+def validate_trace(rec: dict, model) -> Optional[str]:
+    """Model-aware ingestion check for one decoded trace record: action
+    ids must be dense ids of this model, the init index must exist.
+    Returns a refusal reason or None. (Wire decode cannot do this — it
+    has no model; the checker calls it once the factory resolved.)"""
+    A = model.packed_action_count()
+    import jax
+
+    leaves = jax.tree_util.tree_leaves(model.packed_init_states())
+    n_init = int(leaves[0].shape[0]) if leaves else 0
+    if rec["init"] >= n_init:
+        return (
+            f"init index {rec['init']} out of range "
+            f"(model has {n_init} initial states)"
+        )
+    bad = [a for a in rec["actions"] if a >= A]
+    if bad:
+        return (
+            f"action id {bad[0]} out of range (model has {A} actions)"
+        )
+    return None
+
+
+_KERNELS: Dict[tuple, object] = {}
+_KERNELS_LOCK = threading.Lock()
+
+
+def replay_kernel(model, namespace: str, T: int, L: int):
+    """The jitted batch replayer for one (model config, padded length,
+    lane count) shape: ``fn(inits pytree[L, ...], actions (L, T) i32)
+    -> dict of (L,) arrays``. Cached process-globally keyed on the zoo
+    namespace (two jobs submitting the same config share the
+    executable — the conformance analog of the shared AOT wave cache).
+    """
+    key = (namespace, T, L)
+    with _KERNELS_LOCK:
+        fn = _KERNELS.get(key)
+        if fn is not None:
+            return fn
+    import jax
+    import jax.numpy as jnp
+
+    A = model.packed_action_count()
+
+    def lane(init_state, actions):
+        def step(carry, a):
+            state, diverged, div_idx, offending, steps, i = carry
+            active = (a >= 0) & ~diverged
+            nxt, valid = model.packed_step(
+                state, jnp.clip(a, 0, A - 1)
+            )
+            advance = active & valid
+            state = jax.tree_util.tree_map(
+                lambda n, c: jnp.where(advance, n, c), nxt, state
+            )
+            diverge_now = active & ~valid
+            div_idx = jnp.where(diverge_now, i, div_idx)
+            offending = jnp.where(diverge_now, a, offending)
+            diverged = diverged | diverge_now
+            steps = steps + advance.astype(jnp.int32)
+            return (state, diverged, div_idx, offending, steps, i + 1), None
+
+        carry = (
+            init_state,
+            jnp.bool_(False),
+            jnp.int32(-1),
+            jnp.int32(-1),
+            jnp.int32(0),
+            jnp.int32(0),
+        )
+        (state, diverged, div_idx, offending, steps, _), _ = jax.lax.scan(
+            step, carry, actions
+        )
+        hi, lo = model.packed_fingerprint(state)
+        return {
+            "diverged": diverged,
+            "divergence_index": div_idx,
+            "offending_action": offending,
+            "steps": steps,
+            "fp_hi": hi,
+            "fp_lo": lo,
+        }
+
+    fn = jax.jit(jax.vmap(lane))
+    with _KERNELS_LOCK:
+        _KERNELS[key] = fn
+    return fn
+
+
+def clear_replay_kernels() -> None:
+    """Test hook: drop the process-global kernel cache."""
+    with _KERNELS_LOCK:
+        _KERNELS.clear()
+
+
+def warm_replay(model, namespace: str, T: int, L: int):
+    """Compiles the replay executable for one shape by executing it
+    once on an inert batch (all-padding lanes) — the warm pool's
+    conformance registration. Returns the cached kernel."""
+    fn = replay_kernel(model, namespace, T, L)
+    actions = np.full((L, T), -1, np.int32)
+    inits = _gather_inits(model, [0] * L)
+    out = fn(inits, actions)
+    np.asarray(out["diverged"])  # block until the compile+run lands
+    return fn
+
+
+def pad_actions(records: Sequence[dict], T: int, L: int) -> np.ndarray:
+    """(L, T) int32 action grid: row per record padded with -1 (inert),
+    then whole inert rows up to the fixed lane count L — short batches
+    reuse the bucket's compiled executable instead of retracing."""
+    out = np.full((L, T), -1, np.int32)
+    for i, rec in enumerate(records):
+        acts = rec["actions"]
+        out[i, : len(acts)] = acts
+    return out
+
+
+def replay_batch(
+    records: Sequence[dict], model, namespace: str, T: int,
+    lanes: Optional[int] = None,
+) -> List[dict]:
+    """Replays one shape bucket of decoded traces in one vmapped
+    dispatch -> one verdict dict per record, in order: ``{"id", "kind":
+    "trace", "conforms", "divergence_index", "offending_action",
+    "steps", "fingerprint"}``."""
+    if not records:
+        return []
+    L = lanes or len(records)
+    if len(records) > L:
+        raise ValueError(
+            f"{len(records)} traces exceed the {L}-lane batch"
+        )
+    actions = pad_actions(records, T, L)
+    inits = _gather_inits(
+        model, [r["init"] for r in records] + [0] * (L - len(records))
+    )
+    out = replay_kernel(model, namespace, T, L)(inits, actions)
+    out = {k: np.asarray(v) for k, v in out.items()}
+    verdicts = []
+    for i, rec in enumerate(records):
+        diverged = bool(out["diverged"][i])
+        verdicts.append({
+            "id": rec["id"],
+            "kind": "trace",
+            "conforms": not diverged,
+            "divergence_index": (
+                int(out["divergence_index"][i]) if diverged else None
+            ),
+            "offending_action": (
+                int(out["offending_action"][i]) if diverged else None
+            ),
+            "steps": int(out["steps"][i]),
+            "fingerprint": (
+                int(out["fp_hi"][i]) << 32 | int(out["fp_lo"][i])
+            ),
+        })
+    return verdicts
+
+
+def replay_host(rec: dict, model) -> dict:
+    """The concrete host oracle: the same replay as plain python over
+    ``packed_step`` on unbatched arrays. Device verdicts are gated on
+    matching this bit-for-bit (index and offending action included)."""
+    import jax
+    import jax.numpy as jnp
+
+    state = jax.tree_util.tree_map(
+        lambda x: x[rec["init"]], model.packed_init_states()
+    )
+    steps = 0
+    for i, a in enumerate(rec["actions"]):
+        nxt, valid = model.packed_step(state, jnp.int32(a))
+        if not bool(valid):
+            hi, lo = model.packed_fingerprint(state)
+            return {
+                "id": rec["id"], "kind": "trace", "conforms": False,
+                "divergence_index": i, "offending_action": a,
+                "steps": steps,
+                "fingerprint": int(hi) << 32 | int(lo),
+            }
+        state = nxt
+        steps += 1
+    hi, lo = model.packed_fingerprint(state)
+    return {
+        "id": rec["id"], "kind": "trace", "conforms": True,
+        "divergence_index": None, "offending_action": None,
+        "steps": steps, "fingerprint": int(hi) << 32 | int(lo),
+    }

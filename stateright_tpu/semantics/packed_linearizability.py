@@ -264,9 +264,16 @@ class PackedRegisterLinearizability:
 
     # -- the traceable predicate --------------------------------------------
 
-    def predicate(self):
+    def predicate(self, real_time: bool = True):
         """Builds ``fn(hist) -> bool``: True iff a serialization exists.
         vmap over state batches; everything is static-shaped.
+
+        ``real_time=False`` drops the recorded real-time constraints and
+        decides *sequential consistency* instead (host analog:
+        ``SequentialConsistencyTester`` — same search minus
+        ``_violates_real_time``). The packed encoding is unchanged; the
+        constraint words are simply ignored, so one packed batch can be
+        audited under either criterion.
 
         Implementation: dynamic programming over *consumption vectors*
         instead of enumerating the multinomial × 2^C lane grid
@@ -344,7 +351,10 @@ class PackedRegisterLinearizability:
                     inflight = (jnp.uint32(j) == counts[t]) & (kind != 0)
                     present = completed | inflight
                     cvec = jnp.asarray(np.array(c, np.uint32))
-                    rt_ok = (cvec >= constr).all()
+                    rt_ok = (
+                        (cvec >= constr).all() if real_time
+                        else jnp.bool_(True)
+                    )
                     eb = EB[t][j]
                     write_m = jnp.where(m != 0, eb, jnp.uint32(0))
                     # In-flight reads generate their return: no constraint.
@@ -360,11 +370,13 @@ class PackedRegisterLinearizability:
 
         return fn
 
-    def predicate_lanes(self):
+    def predicate_lanes(self, real_time: bool = True):
         """The original lane-grid predicate (every interleaving × every
         in-flight inclusion as an independent lane) — superseded by the
         consumption-vector DP above, kept as an independent oracle for
-        equivalence tests."""
+        equivalence tests. ``real_time=False`` decides sequential
+        consistency (constraint words ignored), mirroring
+        ``predicate``."""
         import jax
         import jax.numpy as jnp
 
@@ -397,7 +409,10 @@ class PackedRegisterLinearizability:
                     & (inc[t] == 1)
                 )
                 present = completed | inflight
-                rt_ok = (consumed >= constr).all()
+                rt_ok = (
+                    (consumed >= constr).all() if real_time
+                    else jnp.bool_(True)
+                )
                 ok &= ~present | rt_ok
                 # Register semantics: completed reads must observe the
                 # current value; writes update it; in-flight ops generate

@@ -14,6 +14,11 @@ Extends the monitor/Explorer HTTP surface with the job API::
                                  mode="swarm" runs seed-deterministic
                                  randomized walks — see README "Swarm
                                  verification")
+                                 mode="conformance" instead takes
+                                 {"records": [wire frames...]} or
+                                 {"corpus": "<stored name>"} and replays/
+                                 audits the upload — see README "Trace
+                                 conformance & consistency auditing"
     GET  /jobs                   every job's status (the UI panel feed)
     GET  /jobs/<id>              one job: state, verdict, latency fields,
                                  and the honest scheduling surface —
@@ -86,6 +91,19 @@ _HTTP_SWARM_SPAWN_KEYS = frozenset({
     "sample_capacity",
     "sample_stride",
     "coverage",
+})
+
+# Conformance jobs (mode="conformance"; conformance/checker.py): the
+# upload IS the work, so there is no model/options surface — just the
+# batch shape and the host-parity gate. The upload arrives as inline
+# wire frames ("records") or a named server-side corpus ("corpus");
+# corpus values are NAMES resolved inside the service's CorpusStore
+# root, never paths — accepting paths would hand remote clients
+# arbitrary server-side file reads (the same reasoning that keeps
+# `resume_from` off the HTTP spawn surface above).
+_HTTP_CONFORMANCE_SPAWN_KEYS = frozenset({
+    "batch_lanes",
+    "parity",
 })
 
 
@@ -205,6 +223,10 @@ class _ServiceHandler(BaseHTTPRequestHandler):
         except (ValueError, json.JSONDecodeError):
             _json_response(self, {"error": "invalid JSON body"}, 400)
             return
+        mode = body.get("mode") or "exhaustive"
+        if mode == "conformance":
+            self._submit_conformance(body)
+            return
         name = body.get("model")
         if not name:
             _json_response(
@@ -218,7 +240,6 @@ class _ServiceHandler(BaseHTTPRequestHandler):
         if not isinstance(spawn, dict):
             _json_response(self, {"error": "spawn must be an object"}, 400)
             return
-        mode = body.get("mode") or "exhaustive"
         allowed = (
             _HTTP_SWARM_SPAWN_KEYS if mode == "swarm" else _HTTP_SPAWN_KEYS
         )
@@ -271,6 +292,99 @@ class _ServiceHandler(BaseHTTPRequestHandler):
             )
             return
         except (ValueError, RuntimeError) as e:
+            _json_response(self, {"error": str(e)}, 400)
+            return
+        _json_response(
+            self, {"job_id": handle.job_id, **handle.status()}, 201
+        )
+
+    def _submit_conformance(self, body) -> None:
+        """mode="conformance" submissions: {"records": [frames...]} for
+        inline wire frames, or {"corpus": "<name>"} naming a server-side
+        corpus. Malformed frames are 400s carrying the wire refusal
+        (line number + reason), not mid-run failures."""
+        records = body.get("records")
+        corpus = body.get("corpus")
+        if (records is None) == (corpus is None):
+            _json_response(
+                self,
+                {"error": "conformance jobs take exactly one of "
+                          "'records' (inline wire frames) or 'corpus' "
+                          "(a stored corpus name)"},
+                400,
+            )
+            return
+        spawn = body.get("spawn") or {}
+        if not isinstance(spawn, dict):
+            _json_response(self, {"error": "spawn must be an object"}, 400)
+            return
+        blocked = set(spawn) - _HTTP_CONFORMANCE_SPAWN_KEYS
+        if blocked:
+            _json_response(
+                self,
+                {"error": f"spawn keys not allowed over HTTP for "
+                          f"mode='conformance': {sorted(blocked)}",
+                 "allowed": sorted(_HTTP_CONFORMANCE_SPAWN_KEYS)},
+                400,
+            )
+            return
+        if corpus is not None:
+            store = getattr(self.service, "corpus_store", None)
+            if store is None:
+                _json_response(
+                    self,
+                    {"error": "no corpus store: the service has no "
+                              "service_dir (submit inline 'records' "
+                              "instead)"},
+                    400,
+                )
+                return
+            try:
+                # A NAME resolved inside the store root — never a path
+                # (validate_corpus_name rejects separators).
+                records = store.load(corpus)
+            except ValueError as e:
+                _json_response(self, {"error": str(e)}, 400)
+                return
+            except FileNotFoundError:
+                _json_response(
+                    self,
+                    {"error": f"no such corpus {corpus!r}",
+                     "corpora": store.list()},
+                    400,
+                )
+                return
+        submit_kwargs = {}
+        if "retry" in body:
+            retry = body.get("retry")
+            if retry is not None and not isinstance(retry, dict):
+                _json_response(
+                    self, {"error": "retry must be an object"}, 400
+                )
+                return
+            submit_kwargs["retry_policy"] = retry
+        try:
+            handle = self.service.submit(
+                conformance=records,
+                mode="conformance",
+                spawn=spawn,
+                priority=body.get("priority") or 0,
+                deadline_s=body.get("deadline_s"),
+                tenant=body.get("tenant"),
+                timeout_s=body.get("timeout_s"),
+                **submit_kwargs,
+            )
+        except QueueFullError as e:
+            _json_response(
+                self,
+                {"error": str(e), "retry_after_s": e.retry_after_s},
+                429,
+                headers={"Retry-After": str(max(1, int(e.retry_after_s)))},
+            )
+            return
+        except (ValueError, RuntimeError) as e:
+            # WireRefusal is a ValueError: a malformed frame 400s with
+            # its line number and reason, at submit.
             _json_response(self, {"error": str(e)}, 400)
             return
         _json_response(

@@ -116,7 +116,59 @@ _DEFAULT_SWARM_SPAWN = {
     "sample_stride": 1,
 }
 
-_JOB_MODES = ("exhaustive", "swarm")
+_JOB_MODES = ("exhaustive", "swarm", "conformance")
+
+# mode="conformance" spawn surface (conformance/checker.py knobs); any
+# other key is a known-at-admission error, not a mid-run TypeError.
+_CONFORMANCE_SPAWN_KEYS = frozenset({"batch_lanes", "parity"})
+
+# The warm pool's conformance geometry: the replay executable compiled
+# per warm shape (padded trace length x lane count) — matches the
+# checker's default batch_lanes and the smallest trace bucket.
+_CONFORMANCE_WARM_T = 16
+_CONFORMANCE_WARM_L = 64
+
+
+def _normalize_conformance(payload):
+    """A conformance submission payload -> ``(canonical wire lines,
+    decoded records)``, strictly validated (the first bad frame raises
+    ``WireRefusal``, a ``ValueError`` — HTTP 400 at the door). Accepts
+    JSONL text, a list of frame lines, or a list of frame objects (raw
+    frames with ``"v"``, or already-decoded records)."""
+    import json as _json
+
+    from ..conformance.wire import decode_lines, encode_record
+
+    if isinstance(payload, str):
+        lines = payload.splitlines()
+    elif isinstance(payload, (list, tuple)):
+        lines = []
+        for item in payload:
+            if isinstance(item, str):
+                lines.append(item)
+            elif isinstance(item, dict):
+                try:
+                    lines.append(encode_record(item))
+                except (KeyError, TypeError, ValueError):
+                    # Not frame-shaped at all: serialize as-is and let
+                    # the strict decode refuse it with a line number.
+                    lines.append(_json.dumps(item))
+            else:
+                raise ValueError(
+                    "conformance frames must be JSONL lines or frame "
+                    f"objects, got {type(item).__name__}"
+                )
+    else:
+        raise ValueError(
+            "conformance payload must be JSONL text, a list of frame "
+            f"lines, or a list of frame objects, got "
+            f"{type(payload).__name__}"
+        )
+    lines = [ln for ln in (s.strip() for s in lines) if ln]
+    if not lines:
+        raise ValueError("conformance payload is empty")
+    records, _refusals = decode_lines(lines, strict=True)
+    return lines, records
 
 # Default job ids are unique across every service in the process (the
 # id is also the run_id, which keys process-global registries).
@@ -256,6 +308,17 @@ class CheckService:
 
             self.aot_store = AotDiskStore(os.path.join(service_dir, "aot"))
             self.seed_store = SeedStore(os.path.join(service_dir, "seeds"))
+        # Conformance corpus persistence: named JSONL uploads under
+        # ``corpus/`` so HTTP clients can submit by NAME (never by
+        # server-side path — see service/http.py's spawn-key security
+        # note) and re-audit a stored corpus after restarts.
+        self.corpus_store = None
+        if service_dir is not None:
+            from ..storage.corpus import CorpusStore
+
+            self.corpus_store = CorpusStore(
+                os.path.join(service_dir, "corpus")
+            )
         from ..telemetry import metrics_registry
 
         reg = metrics_registry()
@@ -347,6 +410,7 @@ class CheckService:
         retry_policy: Optional[RetryPolicy] = "default",
         mode: str = "exhaustive",
         seed: int = 0,
+        conformance=None,
         _warm_pool: bool = False,
     ) -> JobHandle:
         """Admits one check job; returns immediately with a handle.
@@ -364,9 +428,53 @@ class CheckService:
         walk streams — same seed, same verdict, packed or solo)."""
         if self._closing.is_set():
             raise RuntimeError("CheckService is closed")
+        if conformance is not None and mode == "exhaustive":
+            mode = "conformance"
         if mode not in _JOB_MODES:
             raise ValueError(
                 f"unknown mode {mode!r} (supported: {list(_JOB_MODES)})"
+            )
+        conformance_lines = conformance_records = None
+        if mode == "conformance":
+            # Conformance jobs audit recorded executions, not a model:
+            # the payload is wire frames (see conformance/wire.py), the
+            # only tuning surface is the batch geometry, and every other
+            # check-job knob that presupposes exploration is a
+            # known-at-admission error.
+            if conformance is None:
+                raise ValueError(
+                    "mode='conformance' needs conformance= (wire frames: "
+                    "JSONL text, a list of frame lines, or a list of "
+                    "frame objects)"
+                )
+            if model is not None or model_name is not None:
+                raise ValueError(
+                    "conformance jobs audit recorded frames; trace "
+                    "frames name their zoo model inline — do not pass "
+                    "model/model_name"
+                )
+            if options:
+                raise ValueError(
+                    "conformance jobs take no builder options; tune "
+                    f"spawn={sorted(_CONFORMANCE_SPAWN_KEYS)} instead"
+                )
+            if hbm_budget_mib is not None:
+                raise ValueError(
+                    "conformance jobs have no tiered visited store to "
+                    "budget; size batches via spawn={'batch_lanes': ...}"
+                )
+            bad_spawn = set(spawn or {}) - _CONFORMANCE_SPAWN_KEYS
+            if bad_spawn:
+                raise ValueError(
+                    f"unknown conformance spawn keys {sorted(bad_spawn)} "
+                    f"(supported: {sorted(_CONFORMANCE_SPAWN_KEYS)})"
+                )
+            # Strict decode at admission: a malformed frame is a 400 at
+            # the door (WireRefusal is a ValueError), not a burned retry
+            # mid-run. The canonical re-encoded lines are what the
+            # durable journal carries.
+            conformance_lines, conformance_records = (
+                _normalize_conformance(conformance)
             )
         try:
             seed = int(seed)
@@ -461,6 +569,11 @@ class CheckService:
             else:
                 def factory(m=model):
                     return m
+        elif mode == "conformance":
+            # No model to build: trace frames resolve their zoo entry
+            # inside the checker, histories need none at all.
+            def factory():
+                return None
         else:
             raise ValueError("one of model / model_name is required")
         bad = set(options or {}) - set(_BUILDER_OPTIONS)
@@ -499,10 +612,10 @@ class CheckService:
                     "retry_policy must be a RetryPolicy, a dict of its "
                     "fields, or None"
                 )
-        if hbm_budget_mib is None and mode != "swarm":
-            # The service-wide default budget never applies to swarm
-            # jobs — their device footprint is the fixed fleet shape,
-            # not a growing visited table.
+        if hbm_budget_mib is None and mode not in ("swarm", "conformance"):
+            # The service-wide default budget never applies to swarm or
+            # conformance jobs — their device footprint is a fixed lane
+            # shape, not a growing visited table.
             hbm_budget_mib = self.default_hbm_budget_mib
         # Budget-derived table sizing, validated AT ADMISSION: an
         # over-budget request (the budget cannot fit even one worst-case
@@ -514,7 +627,13 @@ class CheckService:
             derived_table_capacity = self._validate_budget(
                 factory, aot_namespace, spawn, hbm_budget_mib
             )
-        if mode == "swarm":
+        if mode == "conformance":
+            packable, packable_reason = False, (
+                "conformance batches are internally lane-packed (lanes "
+                "= traces/histories); cross-tenant packing would break "
+                "per-upload verdict determinism"
+            )
+        elif mode == "swarm":
             packable, packable_reason = self._classify_packable_swarm(
                 aot_namespace=aot_namespace, options=options, spawn=spawn
             )
@@ -591,7 +710,8 @@ class CheckService:
             )
             job.preemptible = (
                 True
-                if mode == "swarm"  # SwarmChecker.supports_preempt
+                # SwarmChecker / ConformanceChecker .supports_preempt
+                if mode in ("swarm", "conformance")
                 else self.spawn_method in _PREEMPTIBLE_SPAWNS
             )
             job.packable = packable
@@ -612,6 +732,12 @@ class CheckService:
             job._journal_model_args = (
                 dict(model_args) if model_name is not None else None
             )
+            if mode == "conformance":
+                # Canonical wire lines for the journal; decoded records
+                # for the checker (decoding is deterministic, so both
+                # incarnations see identical inputs).
+                job._conformance_lines = conformance_lines
+                job._conformance_records = conformance_records
             self._jobs[jid] = job
             self._cond.notify_all()
         self._journal_submit(job)
@@ -690,6 +816,10 @@ class CheckService:
         the honest reason — the PR 12 ``packable_reason`` pattern, so
         unsound-by-default semantics are visible in ``status()`` rather
         than discovered from a missed counterexample."""
+        if mode == "conformance":
+            # Verdicts are per-record replay/audit, not temporal
+            # properties — there is nothing for a liveness mode to mean.
+            return "default", None
         requested = (spawn or {}).get(
             "liveness", self.default_spawn.get("liveness")
         )
@@ -774,6 +904,29 @@ class CheckService:
         job cannot be journaled (a custom ``model_factory`` has no
         serializable identity — surfaced honestly as ``durable: false``
         instead of silently losing the job in a crash)."""
+        if job.mode == "conformance":
+            # The canonical wire lines ARE the job's identity: decoding
+            # is deterministic, so a journal-resubmitted incarnation
+            # audits the exact same records (bit-identical verdicts).
+            spec = {
+                "mode": "conformance",
+                "records": list(getattr(job, "_conformance_lines", [])),
+                "spawn": job.spawn or None,
+                "priority": job.priority,
+                "deadline_s": job.deadline_s,
+                "tenant": job.tenant,
+                "timeout_s": job.timeout_s,
+                "retry_policy": (
+                    job.retry_policy.to_dict()
+                    if job.retry_policy is not None
+                    else None
+                ),
+            }
+            try:
+                json.dumps(spec)
+            except (TypeError, ValueError):
+                return None
+            return spec
         if job.model_name is None:
             return None
         spec = {
@@ -984,17 +1137,30 @@ class CheckService:
             # very recovery the journal exists for.
             saved_limit, svc.max_queued_jobs = svc.max_queued_jobs, None
             try:
-                handle = svc.submit(
-                    model_name=spec.pop("model_name"),
-                    model_args=spec.pop("model_args", None) or {},
-                    job_id=jid,
-                    retry_policy=(
-                        RetryPolicy.from_dict(retry)
-                        if retry is not None
-                        else None
-                    ),
-                    **{k: v for k, v in spec.items() if v is not None},
+                retry_kw = (
+                    RetryPolicy.from_dict(retry)
+                    if retry is not None
+                    else None
                 )
+                if spec.get("mode") == "conformance":
+                    handle = svc.submit(
+                        conformance=spec.pop("records"),
+                        job_id=jid,
+                        retry_policy=retry_kw,
+                        **{
+                            k: v for k, v in spec.items() if v is not None
+                        },
+                    )
+                else:
+                    handle = svc.submit(
+                        model_name=spec.pop("model_name"),
+                        model_args=spec.pop("model_args", None) or {},
+                        job_id=jid,
+                        retry_policy=retry_kw,
+                        **{
+                            k: v for k, v in spec.items() if v is not None
+                        },
+                    )
             except (ValueError, RuntimeError) as e:
                 # One rotten journal entry must not abort the rest of
                 # the replay — surface it as an explicit failed record.
@@ -1174,6 +1340,21 @@ class CheckService:
         self._journal_state(job)
 
     def _spawn(self, job: CheckJob):
+        if job.mode == "conformance":
+            from ..conformance.checker import ConformanceChecker
+
+            sp = job.spawn or {}
+            checker = ConformanceChecker(
+                job._conformance_records,
+                self.zoo,
+                run_id=job.run_id,
+                batch_lanes=int(sp.get("batch_lanes", 64)),
+                parity=bool(sp.get("parity", False)),
+                resume_from=job.payload,
+                tenant=job.tenant,
+            )
+            job.payload = None
+            return checker
         if job.mode == "swarm":
             # Per-namespace instance, not a fresh factory() call: the
             # swarm wave-executable cache pins the model by IDENTITY, so
@@ -1446,6 +1627,7 @@ class CheckService:
                 )
                 entry["job_id"] = handle.job_id
                 handle.result(timeout=600.0)
+                self._warm_conformance(ns, name, args)
                 entry["state"] = "ready"
             except Exception as e:  # noqa: BLE001 - warmth is best-effort
                 entry["state"] = "failed"
@@ -1460,6 +1642,23 @@ class CheckService:
             )
             self._g_pool_ready.set(ready)
             self._g_pool_pending.set(pending)
+
+    def _warm_conformance(self, ns: str, name: str, args: dict) -> None:
+        """Conformance-plane warm-pool registration: the replay
+        executable for this zoo shape, compiled (and executed once on
+        an inert batch) at the default batch geometry, so a first
+        conformance upload of a warm shape replays without the
+        trace+compile stall. Best-effort, like the rest of the pool."""
+        try:
+            from ..conformance.replay import warm_replay
+
+            factory = self.zoo[name]
+            model = self._model_for(lambda: factory(**args), ns)
+            warm_replay(
+                model, ns, _CONFORMANCE_WARM_T, _CONFORMANCE_WARM_L
+            )
+        except Exception:  # noqa: BLE001 - warmth is best-effort
+            pass
 
     def _poll_discoveries(self, job: CheckJob, checker) -> None:
         try:
@@ -2144,6 +2343,11 @@ class CheckService:
             result["liveness"] = checker.liveness_report()
         except Exception:  # noqa: BLE001 - evidence, never the verdict
             pass
+        conf = getattr(checker, "conformance_report", None)
+        if conf is not None:
+            # The conformance plane's verdict block: one verdict per
+            # uploaded record, in upload order, plus batch accounting.
+            result["conformance"] = conf()
         return result
 
     # -- lifecycle ----------------------------------------------------------

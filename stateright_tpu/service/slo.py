@@ -36,7 +36,7 @@ from typing import Dict, Optional
 
 from ..telemetry.metrics import metrics_registry
 
-MODES = ("exhaustive", "swarm", "packed")
+MODES = ("exhaustive", "swarm", "packed", "conformance")
 
 # Error-budget objective and latency targets; targets=None keeps the
 # ledger observational (percentiles/decomposition, no burn gauges).

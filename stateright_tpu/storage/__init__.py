@@ -27,6 +27,7 @@ See README "Memory hierarchy" for the knobs and when eviction pays.
 """
 
 from .bloom import BloomFilter
+from .corpus import CorpusStore, validate_corpus_name
 from .edge_log import LivenessEdgeStore, LivenessInstruments
 from .persist import (
     AotDiskBinding,
@@ -58,6 +59,7 @@ __all__ = [
     "AotDiskBinding",
     "AotDiskStore",
     "BloomFilter",
+    "CorpusStore",
     "FingerprintRun",
     "SeedStore",
     "adapt_seed_checkpoint",
@@ -77,4 +79,5 @@ __all__ = [
     "encode_varint_u64",
     "max_table_rows_for_budget",
     "validate_budget_knobs",
+    "validate_corpus_name",
 ]

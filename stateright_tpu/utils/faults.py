@@ -45,6 +45,7 @@ from typing import Callable, Iterable, List, Optional
 
 __all__ = [
     "CheckpointWriteFault",
+    "ConformanceBatchFault",
     "DeviceWaveFault",
     "FaultError",
     "FaultInjector",
@@ -128,6 +129,14 @@ class SeedLoadFault(OSError, FaultError):
     fault_class = "seed_load"
 
 
+class ConformanceBatchFault(FaultError):
+    """A conformance batch dispatch raised (replay/audit kernel, XLA
+    error). Verdicts are deterministic in the upload, so a retry must
+    recover bit-identically through the journal."""
+
+    fault_class = "conformance_batch"
+
+
 class TenantFaultError(Exception):
     """An engine fault attributable to exactly ONE packed tenant — the
     pack's blast-radius boundary. The service drops only this tenant
@@ -205,6 +214,9 @@ _SITE_EXC = {
     # Warm-start plane (storage/persist.py): the seed-artifact read —
     # refusal must degrade to a full recheck, never a wrong verdict.
     "warmstart.seed_load": SeedLoadFault,
+    # Conformance plane (conformance/checker.py): the per-batch device
+    # dispatch — the retry seam for uploaded-trace auditing.
+    "conformance.batch": ConformanceBatchFault,
 }
 
 # Sites that exist in the tree — fail fast on typos in test specs.

@@ -1,0 +1,487 @@
+"""Conformance plane: wire codec, device/host parity, service traffic.
+
+The load-bearing guarantee is **bit-identity**: every device verdict —
+lin/SC consistency for histories, first-divergence index + offending
+action for traces — must equal the host oracle on the same record
+(``audit.host_is_consistent`` / ``replay.replay_host``). The randomized
+parity sweeps here run hundreds of seeded histories per shape bucket,
+covering the edges the packed codecs must model: in-flight tail ops,
+double invokes, orphan returns, wrong returns.
+"""
+
+import json
+import os
+import random
+import time
+import threading
+
+import pytest
+
+from stateright_tpu.conformance import (
+    ConformanceChecker,
+    WireRefusal,
+    audit_batch,
+    bucket_records,
+    decode_lines,
+    encode_record,
+    host_is_consistent,
+    mutate_trace,
+    random_history,
+    random_walk_trace,
+    replay_batch,
+    replay_host,
+)
+from stateright_tpu.conformance.audit import pack_history
+from stateright_tpu.service.jobs import JobHandle, RetryPolicy
+from stateright_tpu.service.service import CheckService
+from stateright_tpu.service.zoo import aot_namespace, default_zoo
+from stateright_tpu.telemetry import registry_hygiene_problems
+from stateright_tpu.telemetry.metrics import metrics_registry
+from stateright_tpu.utils.faults import FaultSpec, inject
+
+REPO_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SEED_CORPUS = os.path.join(
+    REPO_DIR, "examples", "conformance_corpus.jsonl"
+)
+
+# Every history shape bucket the parity sweep covers: (spec, semantics,
+# client threads, ops per thread).
+HISTORY_SHAPES = (
+    ("register", "linearizability", 2, 2),
+    ("register", "sequential", 2, 2),
+    ("register", "linearizability", 3, 2),
+    ("vec", "linearizability", 2, 2),
+    ("vec", "sequential", 2, 2),
+)
+
+
+def _histories(seed, n, spec, semantics, threads, ops):
+    """n seeded histories for one shape, cycling clean/random/invalid
+    (random mode leaves tail ops in flight ~25% of the time)."""
+    rng = random.Random(seed)
+    modes = ("clean", "random", "invalid")
+    return [
+        random_history(
+            rng, spec=spec, semantics=semantics, threads=threads,
+            ops_per_thread=ops, mode=modes[i % 3],
+            rec_id=f"{spec[:3]}-{semantics[:3]}-{i}",
+        )
+        for i in range(n)
+    ]
+
+
+# -- wire --------------------------------------------------------------------
+
+
+def test_wire_roundtrip_all_shapes():
+    records = []
+    for i, (spec, semantics, c, o) in enumerate(HISTORY_SHAPES):
+        records += _histories(100 + i, 30, spec, semantics, c, o)
+    lines = [encode_record(r) for r in records]
+    decoded, refusals = decode_lines(lines)
+    assert not refusals, refusals[:2]
+    assert len(decoded) == len(records)
+    for orig, dec in zip(records, decoded):
+        # Prefix compare: the decoder stops at a latching client bug
+        # (double invoke / orphan return) — the host testers refuse
+        # everything after the latch, so the tail is unreachable.
+        assert dec["events"] == [
+            tuple(e) for e in orig["events"][: len(dec["events"])]
+        ]
+        assert dec["semantics"] == orig["semantics"]
+        assert dec["spec"] == orig["spec"]
+        if orig["meta"].get("expect") != "invalid":
+            assert len(dec["events"]) == len(orig["events"])
+
+
+def test_wire_trace_roundtrip_exact():
+    zoo = default_zoo()
+    model = zoo["increment_lock"]()
+    rng = random.Random(3)
+    rec = random_walk_trace(
+        model, rng, 10, model_name="increment_lock"
+    )
+    decoded, refusals = decode_lines([encode_record(rec)])
+    assert not refusals
+    assert decoded[0]["actions"] == rec["actions"]
+    assert decoded[0]["init"] == rec["init"]
+    assert decoded[0]["model"] == "increment_lock"
+
+
+def test_wire_refusals_are_honest():
+    bad = [
+        "not json at all",
+        json.dumps({"kind": "trace", "id": "x"}),  # no version
+        json.dumps({"v": 99, "kind": "trace", "id": "x"}),
+        json.dumps({"v": 1, "kind": "trace", "id": "x"}),  # no model
+        json.dumps({"v": 1, "kind": "history", "id": "h",
+                    "spec": "register", "semantics": "causal",
+                    "events": []}),  # unknown semantics
+        json.dumps({"v": 1, "kind": "history", "id": "h",
+                    "spec": "register",
+                    "semantics": "linearizability",
+                    "events": [["banana", 0]]}),  # bad event type
+    ]
+    decoded, refusals = decode_lines(bad)
+    assert decoded == []
+    assert len(refusals) == len(bad)
+    for i, r in enumerate(refusals):
+        assert r["line"] == i + 1  # 1-based line numbers in refusals
+        assert r["reason"]
+    with pytest.raises(WireRefusal):
+        decode_lines(bad, strict=True)
+
+
+# -- device/host parity: histories -------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "spec,semantics,threads,ops",
+    HISTORY_SHAPES,
+    ids=[f"{s}-{m[:3]}-C{c}O{o}" for s, m, c, o in HISTORY_SHAPES],
+)
+def test_history_parity_randomized(spec, semantics, threads, ops):
+    """>=500 seeded histories per shape bucket: the vmapped device
+    verdict equals the host tester's on every one, and the
+    by-construction labels hold (clean => consistent, invalid =>
+    inconsistent)."""
+    records = _histories(42, 500, spec, semantics, threads, ops)
+    lines = [encode_record(r) for r in records]
+    decoded, refusals = decode_lines(lines)
+    assert not refusals, refusals[:2]
+    assert len(decoded) == len(records)
+    mismatches = 0
+    checked = []
+    refused = []
+    # The real ingestion pipeline: wire-decoded records, bucketed by
+    # exact shape (an injected double invoke bumps a record's O, so a
+    # mixed sweep spans several buckets), one dispatch per bucket.
+    for recs in bucket_records(decoded).values():
+        verdicts = audit_batch(recs)
+        assert len(verdicts) == len(recs)
+        for rec, v in zip(recs, verdicts):
+            if v.get("refused") is not None:
+                # A client-bug injection can bump a record past the
+                # device compile-sanity bounds; the refusal must be
+                # honest (named bound, only ever an invalid record —
+                # clean/random records stay inside the sweep's shape).
+                assert rec["meta"]["expect"] == "invalid", (
+                    rec["id"], v,
+                )
+                assert "bound is" in v["refused"], v
+                refused.append(rec["id"])
+                continue
+            checked.append(rec["id"])
+            host = host_is_consistent(rec)
+            if bool(v["consistent"]) != host:
+                mismatches += 1
+            expect = rec["meta"]["expect"]
+            if expect == "consistent":
+                assert v["consistent"], rec["id"]
+            elif expect == "invalid":
+                assert not v["consistent"], rec["id"]
+                assert not v["valid_history"], rec["id"]
+    assert len(checked) + len(refused) == len(records)
+    # Refusals are the over-bound tail, never the bulk of the sweep.
+    assert len(checked) >= (2 * len(records)) // 3
+    assert mismatches == 0
+
+
+def test_sequential_weaker_than_linearizability():
+    """SC drops the real-time constraint: every linearizable history is
+    SC-consistent, and some SC-consistent histories are NOT
+    linearizable (stale reads of non-overlapping ops). Both facts must
+    show up in a randomized sweep."""
+    rows = _histories(9, 300, "register", "linearizability", 2, 2)
+    decoded, refusals = decode_lines([encode_record(r) for r in rows])
+    assert not refusals
+    gap = 0
+    for recs in bucket_records(decoded).values():
+        lin_v = audit_batch(recs)
+        sc_v = audit_batch(
+            [dict(r, semantics="sequential") for r in recs]
+        )
+        for lv, sv in zip(lin_v, sc_v):
+            if lv["consistent"]:
+                assert sv["consistent"]  # lin => SC
+            if sv["consistent"] and not lv["consistent"]:
+                gap += 1
+    assert gap > 0, "sweep never exercised the lin/SC gap"
+
+
+# -- device/host parity: traces ----------------------------------------------
+
+
+def _trace_bundle(model_name, seed=5, n=6, steps=10):
+    zoo = default_zoo()
+    model = zoo[model_name]()
+    rng = random.Random(seed)
+    clean = [
+        random_walk_trace(
+            model, rng, steps, rec_id=f"{model_name}-{i}",
+            model_name=model_name,
+        )
+        for i in range(n)
+    ]
+    mutated = [m for m in (
+        mutate_trace(model, rng, r) for r in clean
+    ) if m is not None]
+    return model, clean + mutated
+
+
+@pytest.mark.parametrize("model_name", ["increment_lock", "2pc"])
+def test_trace_parity_bit_identical(model_name):
+    """Device replay verdicts equal the host oracle on all five fields
+    (conforms, divergence index, offending action, steps, final
+    fingerprint) for clean and known-divergent traces."""
+    model, records = _trace_bundle(model_name)
+    assert any(r["id"].endswith("-div") for r in records)
+    T = max(len(r["actions"]) for r in records)
+    ns = aot_namespace(model_name, {})
+    verdicts = replay_batch(records, model, ns, T, lanes=16)
+    for rec, v in zip(records, verdicts):
+        host = replay_host(rec, model)
+        assert v == host, (rec["id"], v, host)
+        if rec["id"].endswith("-div"):
+            assert not v["conforms"]
+            assert v["divergence_index"] == (
+                rec["meta"]["divergence_index"]
+            )
+            assert v["offending_action"] == (
+                rec["meta"]["offending_action"]
+            )
+        else:
+            assert v["conforms"] and v["divergence_index"] is None
+
+
+def test_trace_padding_is_inert():
+    """A short trace in a long lane bucket must score identically to
+    the same trace in a tight bucket (padding never steps)."""
+    model, records = _trace_bundle("increment_lock", n=3)
+    ns = aot_namespace("increment_lock", {})
+    T = max(len(r["actions"]) for r in records)
+    tight = replay_batch(records, model, ns, T, lanes=len(records))
+    padded = replay_batch(records, model, ns, T + 7, lanes=64)
+    assert tight == padded
+
+
+# -- checker + seed corpus ---------------------------------------------------
+
+
+def _seed_records():
+    with open(SEED_CORPUS, encoding="utf-8") as f:
+        lines = [ln for ln in f if ln.strip()]
+    records, refusals = decode_lines(lines)
+    assert not refusals
+    return lines, records
+
+
+def test_seed_corpus_checker_parity_and_hygiene():
+    """The checked-in corpus through ConformanceChecker with the host
+    parity gate ON: labels hold, metrics registry passes the hygiene
+    lint, report counts are consistent."""
+    lines, records = _seed_records()
+    ck = ConformanceChecker(
+        records, default_zoo(), run_id="t-conf-seed", parity=True,
+        batch_lanes=32,
+    )
+    deadline = time.monotonic() + 300
+    while not ck.is_done() and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert ck.is_done() and ck.worker_error() is None
+    rep = ck.conformance_report()
+    # The corpus deliberately carries one history past the register DP
+    # compile-sanity bound — an honest refusal, not a stall.
+    refuse_ids = {
+        r["id"] for r in records
+        if r["kind"] == "history" and pack_history(r)[1] is not None
+    }
+    assert rep["refusals"] == len(refuse_ids) > 0
+    assert (
+        rep["traces"] + rep["histories"] + rep["refusals"]
+        == len(records)
+    )
+    n_div_labels = sum(
+        1 for r in records if r["kind"] == "trace"
+        and r["meta"].get("expect") == "divergent"
+    )
+    assert rep["divergences"] == n_div_labels
+    for rec, v in zip(records, rep["records"]):
+        if rec["kind"] != "trace":
+            continue
+        if rec["meta"].get("expect") == "divergent":
+            assert v["divergence_index"] == (
+                rec["meta"]["divergence_index"]
+            ), (rec["id"], v)
+        else:
+            assert v["conforms"], (rec["id"], v)
+    assert registry_hygiene_problems(
+        metrics_registry("t-conf-seed")
+    ) == []
+
+
+def test_checker_refuses_unknown_model_not_crashes():
+    rec = {
+        "kind": "trace", "id": "t", "model": "no-such-model",
+        "model_args": {}, "init": 0, "actions": [0], "meta": {},
+    }
+    ck = ConformanceChecker([rec], default_zoo(), parity=False)
+    deadline = time.monotonic() + 60
+    while not ck.is_done() and time.monotonic() < deadline:
+        time.sleep(0.01)
+    rep = ck.conformance_report()
+    assert rep["refusals"] == 1
+    assert "no-such-model" in rep["records"][0]["refused"]
+
+
+# -- service traffic class ---------------------------------------------------
+
+
+def _svc(**kw):
+    kw.setdefault("warm_start", False)
+    return CheckService(**kw)
+
+
+def test_service_conformance_job_end_to_end(tmp_path):
+    lines, records = _seed_records()
+    svc = _svc(service_dir=str(tmp_path / "svc"))
+    try:
+        h = svc.submit(conformance=lines, spawn={"parity": True})
+        res = h.result(timeout=300)
+        conf = res["conformance"]
+        assert len(conf["records"]) == len(records)
+        # The corpus's one over-bound history surfaces as an honest
+        # per-record refusal in the service verdict too.
+        n_refuse = sum(
+            1 for r in records
+            if r["kind"] == "history"
+            and pack_history(r)[1] is not None
+        )
+        assert conf["refusals"] == n_refuse > 0
+        assert conf["divergences"] >= 1
+        st = h.status()
+        assert st["mode"] == "conformance"
+        assert st["packable"] is False  # honest scheduling surface
+        # Named-corpus store round-trip (the HTTP "corpus" field's
+        # backing): names only, never paths.
+        svc.corpus_store.save("seed", lines)
+        assert svc.corpus_store.list() == ["seed"]
+        with pytest.raises(ValueError, match="invalid corpus name"):
+            svc.corpus_store.load("../../etc/passwd")
+    finally:
+        svc.close()
+
+
+def test_service_conformance_rejects_model_surface():
+    svc = _svc()
+    try:
+        with pytest.raises(ValueError, match="model"):
+            svc.submit(
+                conformance=["{}"], model_name="2pc",
+                mode="conformance",
+            )
+        with pytest.raises(ValueError, match="spawn"):
+            svc.submit(
+                conformance=["{}"],
+                spawn={"resume_from": "/tmp/evil"},
+            )
+        with pytest.raises(WireRefusal):
+            svc.submit(conformance=['{"v": 1, "kind": "trace"}'])
+    finally:
+        svc.close()
+
+
+def test_service_conformance_fault_retry_bit_identical(tmp_path):
+    """A conformance.batch fault mid-audit: the retry recovers through
+    the journal and the final verdicts are bit-identical to a
+    fault-free run of the same upload."""
+    lines, _ = _seed_records()
+    svc = _svc(service_dir=str(tmp_path / "svc"))
+    try:
+        clean = svc.submit(conformance=lines).result(timeout=300)
+        with inject(FaultSpec("conformance.batch", at=0)):
+            h = svc.submit(
+                conformance=lines,
+                retry_policy=RetryPolicy(
+                    max_retries=2, backoff_s=0.01
+                ),
+            )
+            res = h.result(timeout=300)
+        assert h.status()["retries"] >= 1
+        assert h.status()["faults"], "fault never injected"
+        assert res["conformance"]["records"] == (
+            clean["conformance"]["records"]
+        )
+    finally:
+        svc.close()
+
+
+def test_service_conformance_journal_recovery(tmp_path):
+    """A journaled-but-never-run conformance job replays from its
+    durable spec (the canonical wire lines) on recover(), bit-identical
+    to a fresh submission."""
+    lines, _ = _seed_records()
+    d = str(tmp_path / "svc")
+    os.makedirs(os.path.join(d, "jobs"), exist_ok=True)
+    spec = {
+        "mode": "conformance", "records": lines,
+        "spawn": {"parity": False}, "priority": 0,
+        "deadline_s": None, "tenant": None, "timeout_s": None,
+        "retry_policy": None,
+    }
+    with open(os.path.join(d, "journal.jsonl"), "w") as f:
+        f.write(json.dumps({
+            "ev": "submit", "t": 0.0, "job_id": "conf-rec",
+            "durable": True, "spec": spec,
+        }) + "\n")
+    svc = CheckService.recover(d, warm_start=False)
+    try:
+        job = svc.job("conf-rec")
+        assert job is not None and job.state != "failed", (
+            job and job.error
+        )
+        r_rec = JobHandle(job, svc).result(timeout=300)
+        r_fresh = svc.submit(conformance=lines).result(timeout=300)
+        assert r_rec["conformance"]["records"] == (
+            r_fresh["conformance"]["records"]
+        )
+    finally:
+        svc.close()
+
+
+def test_service_conformance_preempt_resume_bit_identical(tmp_path):
+    """Driven-slice preemption mid-upload: the resumed incarnation's
+    verdict table equals an uninterrupted run's exactly (the preempt
+    payload carries the verdict cursor, not partial batches)."""
+    lines, records = _seed_records()
+    svc = _svc(service_dir=str(tmp_path / "svc"), quantum_s=30.0)
+    try:
+        # Baseline first, while the scheduler is still alive; parking
+        # it below (join) is permanent for this service instance.
+        baseline = svc.submit(conformance=lines).result(timeout=300)
+        svc._closing.set()
+        svc._wake()
+        svc._scheduler.join(timeout=30)
+        svc._closing.clear()
+        h = svc.submit(conformance=lines, spawn={"batch_lanes": 4})
+        job = svc.job(h.job_id)
+        t = threading.Thread(target=svc._run_slice, args=(job,))
+        t.start()
+        deadline = time.monotonic() + 60
+        while (
+            svc._active_checker is None
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.001)
+        ck = svc._active_checker
+        assert ck is not None
+        ck.request_preempt()
+        t.join(timeout=180)
+        if job.state == "suspended":  # preempt landed mid-upload
+            svc._run_slice(job)
+        assert job.state == "done", (job.state, job.error)
+        assert job.result["conformance"]["records"] == (
+            baseline["conformance"]["records"]
+        )
+    finally:
+        svc.close()
