@@ -4,8 +4,10 @@
 history packs into a fixed-width u32 vector and the Wing&Gong search
 becomes a static-shaped traceable predicate. This module generalizes it
 from one in-wave register history to the conformance plane's workload —
-a vmapped *batch* of uploaded histories per (spec, semantics, C, O)
-shape bucket:
+a vmapped *batch* of uploaded histories per (spec, semantics, C, O,
+default) shape bucket (``default`` is the register's initial value —
+the kernel bakes it into the traced predicate, so it is part of the
+bucket identity; None for vec):
 
 - **register** histories ride ``PackedRegisterLinearizability``
   unchanged: ingestion drives the host ``LinearizabilityTester`` (which
@@ -156,15 +158,20 @@ class PackedVecHistory:
         self.O = O
         self.TW = 1 + O * (self.SW + C)
         self.width = 1 + C * self.TW
-        seq_t, seq_j = _interleavings(C, O)
-        self.lanes = seq_t.shape[0] * (1 << C)
+        # Bound check FIRST, arithmetically: the interleaving count is
+        # the multinomial (C*O)!/(O!)^C, so a hostile shape (say 5x5 ->
+        # ~6e14 sequences) must be refused before _interleavings — a
+        # full recursive enumeration — ever runs, or the refusal itself
+        # parks the worker in unbounded CPU/memory.
+        n_seqs = math.factorial(C * O) // (math.factorial(O) ** C)
+        self.lanes = n_seqs * (1 << C)
         if self.lanes > MAX_VEC_LANES:
             raise ValueError(
                 f"vec history lane grid {self.lanes} exceeds "
                 f"{MAX_VEC_LANES} ({C} threads x {O} ops); split the "
                 "history or audit it on the host"
             )
-        self._seqs = (seq_t, seq_j)
+        self._seqs = _interleavings(C, O)
 
     def _slot(self, c: int, j: int) -> int:
         return 1 + c * self.TW + 1 + j * (self.SW + self.C)
@@ -205,7 +212,13 @@ class PackedVecHistory:
                         out[b + 2] = 2
                         out[b + 3] = ord(value[1])
                 elif tag == "Len":
-                    out[b + 3] = value
+                    # The wire admits any non-negative LenOk, but the
+                    # stack can never hold more than C*O entries, so any
+                    # larger payload is equally unsatisfiable — clamp to
+                    # C*O+1 rather than overflow the u32 slot (the host
+                    # oracle reports such a history inconsistent, not a
+                    # worker error).
+                    out[b + 3] = min(int(value), C * O + 1)
                 counts[c] += 1
                 out[1 + c * self.TW] = counts[c]
         return out
@@ -371,12 +384,18 @@ def clear_audit_kernels() -> None:
         _KERNELS.clear()
 
 
-def audit_batch(records: Sequence[dict]) -> List[dict]:
+def audit_batch(records: Sequence[dict],
+                lanes: Optional[int] = None) -> List[dict]:
     """Audits one shape bucket of decoded histories in one vmapped
     device dispatch. All records MUST share ``bucket_key`` (the checker
     guarantees it). Returns one verdict dict per record, in order:
     ``{"id", "kind": "history", "semantics", "consistent",
     "valid_history"}`` or ``{"id", "kind": "history", "refused": ...}``.
+
+    ``lanes`` pads short batches to a fixed row count with inert
+    all-zero vectors (``valid=0``; their verdicts are discarded) so a
+    resident service reuses one jitted executable per bucket instead of
+    retracing for every distinct chunk size.
     """
     if not records:
         return []
@@ -400,7 +419,13 @@ def audit_batch(records: Sequence[dict]) -> List[dict]:
             verdicts.append(None)
     if packed:
         fn = audit_kernel(spec, semantics, C, O, default)
-        out = np.asarray(fn(np.stack(packed)))
+        batch = np.stack(packed)
+        if lanes is not None and batch.shape[0] < lanes:
+            pad = np.zeros(
+                (lanes - batch.shape[0], batch.shape[1]), np.uint32
+            )
+            batch = np.concatenate([batch, pad])
+        out = np.asarray(fn(batch))[: len(packed)]
     else:
         out = np.zeros((0,), bool)
     for i, rec in enumerate(records):

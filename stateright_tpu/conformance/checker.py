@@ -70,8 +70,11 @@ def bucket_label(key: tuple) -> str:
         _kind, model, args, T = key
         arg_s = ",".join(f"{k}={v}" for k, v in args)
         return f"trace:{model}({arg_s})[T={T}]"
-    _kind, spec, semantics, C, O = key
-    return f"history:{spec}/{semantics}[C={C},O={O}]"
+    _kind, spec, semantics, C, O, default = key
+    shape = f"C={C},O={O}"
+    if default is not None:
+        shape += f",default={default}"
+    return f"history:{spec}/{semantics}[{shape}]"
 
 
 class ConformanceChecker(Checker):
@@ -279,7 +282,7 @@ class ConformanceChecker(Checker):
             recs = [self._records[i] for i in chunk]
             fault_point("conformance.batch", tenant=self._tenant)
             t0 = time.perf_counter()
-            verdicts = audit_batch(recs)
+            verdicts = audit_batch(recs, lanes=L)
             dt = time.perf_counter() - t0
             self._m_batches.inc()
             self._m_lanes.observe(len(chunk))

@@ -410,7 +410,13 @@ def bucket_key(rec: dict) -> tuple:
             _next_pow2(len(rec["actions"])),
         )
     C, O = history_shape(rec)
-    return ("history", rec["spec"], rec["semantics"], C, O)
+    # The register audit kernel bakes ord(default) into the traced
+    # predicate, so two histories with the same shape but different
+    # defaults must NOT batch into one dispatch — the second would be
+    # audited against the wrong initial register value. Vec histories
+    # have no default; key None so they still share a bucket.
+    default = rec["default"] if rec["spec"] == "register" else None
+    return ("history", rec["spec"], rec["semantics"], C, O, default)
 
 
 def bucket_records(records: Sequence[dict]) -> Dict[tuple, List[dict]]:
